@@ -1,0 +1,4 @@
+"""Algorithm registry population (reference: ``sheeprl/__init__.py:18-47``)."""
+
+from sheeprl_tpu.algos.ppo import ppo as _ppo  # noqa: F401
+from sheeprl_tpu.algos.ppo import evaluate as _ppo_eval  # noqa: F401

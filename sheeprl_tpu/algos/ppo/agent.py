@@ -1,0 +1,119 @@
+"""PPO agent (reference: ``/root/reference/sheeprl/algos/ppo/agent.py:91-369``).
+
+TPU-native design: one flax module holding the shared ``MultiEncoder`` plus actor/critic
+MLP heads; there is no separate ``PPOPlayer`` — acting and training use the same pure
+``apply`` with the same replicated params (the reference ties weights between a
+DDP-wrapped trainer module and a single-device player, ``agent.py:363-368``; with pjit
+that duplication disappears)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models.blocks import MLP, MultiEncoder
+
+
+def parse_action_space(action_space: gymnasium.spaces.Space) -> Tuple[bool, Tuple[int, ...]]:
+    """Return (is_continuous, dims). For discrete spaces dims are per-component
+    cardinalities; for Box it is the action dimensionality."""
+    if isinstance(action_space, gymnasium.spaces.Box):
+        return True, (int(np.prod(action_space.shape)),)
+    if isinstance(action_space, gymnasium.spaces.Discrete):
+        return False, (int(action_space.n),)
+    if isinstance(action_space, gymnasium.spaces.MultiDiscrete):
+        return False, tuple(int(n) for n in action_space.nvec)
+    raise ValueError(f"Unsupported action space: {type(action_space)}")
+
+
+class PPOAgent(nn.Module):
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    action_dims: Sequence[int]
+    is_continuous: bool
+    cnn_stacked: bool = False
+    screen_size: int = 64
+    cnn_features_dim: int = 512
+    mlp_features_dim: int = 64
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: str = "tanh"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> Tuple[List[jax.Array], jax.Array]:
+        feat = MultiEncoder(
+            cnn_keys=self.cnn_keys,
+            mlp_keys=self.mlp_keys,
+            cnn_stacked=self.cnn_stacked,
+            cnn_features_dim=self.cnn_features_dim,
+            mlp_hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            mlp_features_dim=self.mlp_features_dim,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="feature_extractor",
+        )(obs)
+        pre_actor = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="actor_backbone",
+        )(feat)
+        critic = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            output_dim=1,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="critic",
+        )(feat)
+        if self.is_continuous:
+            # A single head emitting [mean, log_std] (reference agent.py:157-162).
+            out = nn.Dense(2 * self.action_dims[0], dtype=self.dtype, name="actor_head")(pre_actor)
+            actor_out = [out.astype(jnp.float32)]
+        else:
+            actor_out = [
+                nn.Dense(d, dtype=self.dtype, name=f"actor_head_{i}")(pre_actor).astype(jnp.float32)
+                for i, d in enumerate(self.action_dims)
+            ]
+        return actor_out, critic.astype(jnp.float32)
+
+
+def build_agent(
+    ctx,
+    action_space: gymnasium.spaces.Space,
+    obs_space: gymnasium.spaces.Dict,
+    cfg: Dict[str, Any],
+) -> Tuple[PPOAgent, Any]:
+    """Construct the module and initialise replicated params on the mesh."""
+    is_continuous, dims = parse_action_space(action_space)
+    agent = PPOAgent(
+        cnn_keys=list(cfg.algo.cnn_keys.encoder),
+        mlp_keys=list(cfg.algo.mlp_keys.encoder),
+        action_dims=dims,
+        is_continuous=is_continuous,
+        cnn_stacked=any(len(obs_space[k].shape) == 4 for k in cfg.algo.cnn_keys.encoder),
+        screen_size=cfg.env.screen_size,
+        cnn_features_dim=cfg.algo.encoder.cnn_features_dim,
+        mlp_features_dim=cfg.algo.encoder.mlp_features_dim,
+        dense_units=cfg.algo.dense_units,
+        mlp_layers=cfg.algo.mlp_layers,
+        dense_act=cfg.algo.dense_act,
+        layer_norm=cfg.algo.layer_norm,
+        dtype=ctx.compute_dtype,
+    )
+    dummy_obs = {}
+    for k in list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder):
+        space = obs_space[k]
+        dummy_obs[k] = jnp.zeros((1, *space.shape), dtype=space.dtype)
+    params = agent.init(ctx.rng(), dummy_obs)
+    params = ctx.replicate(params)
+    return agent, params

@@ -1,0 +1,43 @@
+"""PPO losses as pure functions (reference: ``/root/reference/sheeprl/algos/ppo/loss.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def policy_loss(
+    new_logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    advantages: jax.Array,
+    clip_coef: jax.Array,
+    reduction: str = "mean",
+) -> jax.Array:
+    """Clipped surrogate objective (reference ``loss.py:6-42``)."""
+    ratio = jnp.exp(new_logprobs - old_logprobs)
+    surr1 = advantages * ratio
+    surr2 = advantages * jnp.clip(ratio, 1.0 - clip_coef, 1.0 + clip_coef)
+    obj = jnp.minimum(surr1, surr2)
+    return -(obj.mean() if reduction == "mean" else obj.sum())
+
+
+def value_loss(
+    new_values: jax.Array,
+    old_values: jax.Array,
+    returns: jax.Array,
+    clip_coef: jax.Array,
+    clip_vloss: bool,
+    reduction: str = "mean",
+) -> jax.Array:
+    """MSE value loss, optionally clipped around the old values (reference ``:46-63``)."""
+    if not clip_vloss:
+        err = (new_values - returns) ** 2
+        return err.mean() if reduction == "mean" else err.sum()
+    clipped = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+    err = jnp.maximum((new_values - returns) ** 2, (clipped - returns) ** 2)
+    return 0.5 * (err.mean() if reduction == "mean" else err.sum())
+
+
+def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:
+    """Negative mean entropy (reference ``:66-75``)."""
+    return -(entropy.mean() if reduction == "mean" else entropy.sum())

@@ -1,0 +1,108 @@
+"""Checkpoint save/restore.
+
+Reference behavior (``sheeprl/utils/callback.py:14-148`` + ``cli.py:23-58``): periodic
+checkpoints of model/optimizer/aux state plus optional replay-buffer state, ``keep_last``
+GC, and config-compatibility rules on resume.
+
+TPU-native design: device pytrees (params, optimizer states, moments) are serialised
+with ``flax.serialization`` to msgpack; host-side python state (Ratio, counters, buffer
+state dicts) is pickled alongside.  Everything lands in one directory per checkpoint so
+GC is an rmtree.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+from flax import serialization
+
+PROTECTED_RESUME_KEYS = ("env", "algo", "buffer", "checkpoint", "distribution", "exp_name", "seed")
+
+
+def _is_device_tree(value: Any) -> bool:
+    leaves = jax.tree.leaves(value)
+    return len(leaves) > 0 and all(hasattr(leaf, "dtype") for leaf in leaves)
+
+
+class CheckpointManager:
+    def __init__(self, ckpt_dir: os.PathLike, keep_last: Optional[int] = 5):
+        self.ckpt_dir = Path(ckpt_dir)
+        self.keep_last = keep_last
+
+    def save(self, step: int, state: Dict[str, Any]) -> Path:
+        """``state`` maps names to either device pytrees or picklable host objects."""
+        if jax.process_index() != 0:
+            return self.ckpt_dir / f"ckpt_{step}"
+        out = self.ckpt_dir / f"ckpt_{step}"
+        tmp = self.ckpt_dir / f".tmp_ckpt_{step}"
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        manifest: Dict[str, str] = {}
+        for name, value in state.items():
+            if _is_device_tree(value):
+                host_value = jax.device_get(value)
+                (tmp / f"{name}.msgpack").write_bytes(serialization.to_bytes(host_value))
+                manifest[name] = "msgpack"
+                # Template for structure restoration.
+                with open(tmp / f"{name}.template.pkl", "wb") as f:
+                    pickle.dump(jax.tree.map(lambda x: None, host_value), f)
+            else:
+                with open(tmp / f"{name}.pkl", "wb") as f:
+                    pickle.dump(value, f)
+                manifest[name] = "pickle"
+        with open(tmp / "manifest.pkl", "wb") as f:
+            pickle.dump({"step": step, "entries": manifest}, f)
+        if out.exists():
+            shutil.rmtree(out)
+        tmp.rename(out)
+        self._gc()
+        return out
+
+    def _gc(self) -> None:
+        if not self.keep_last:
+            return
+        ckpts = self.list_checkpoints()
+        for old in ckpts[: -self.keep_last]:
+            shutil.rmtree(old, ignore_errors=True)
+
+    def list_checkpoints(self) -> List[Path]:
+        if not self.ckpt_dir.exists():
+            return []
+        ckpts = [p for p in self.ckpt_dir.iterdir() if p.is_dir() and p.name.startswith("ckpt_")]
+        return sorted(ckpts, key=lambda p: int(p.name.split("_")[1]))
+
+    @staticmethod
+    def load(ckpt_path: os.PathLike, templates: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Load a checkpoint directory. ``templates`` provides target pytrees for
+        msgpack entries (required to restore dtypes/shapes as jax arrays)."""
+        ckpt_path = Path(ckpt_path)
+        with open(ckpt_path / "manifest.pkl", "rb") as f:
+            manifest = pickle.load(f)
+        state: Dict[str, Any] = {"_step": manifest["step"]}
+        for name, kind in manifest["entries"].items():
+            if kind == "msgpack":
+                raw = (ckpt_path / f"{name}.msgpack").read_bytes()
+                if templates and name in templates:
+                    state[name] = serialization.from_bytes(templates[name], raw)
+                else:
+                    state[name] = serialization.msgpack_restore(raw)
+            else:
+                with open(ckpt_path / f"{name}.pkl", "rb") as f:
+                    state[name] = pickle.load(f)
+        return state
+
+
+def validate_resume_config(old_cfg: Dict[str, Any], new_cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge a checkpoint's config into the current one, protecting the keys the
+    reference refuses to change on resume (``cli.py:48-52``)."""
+    merged = dict(new_cfg)
+    for key in PROTECTED_RESUME_KEYS:
+        if key in old_cfg:
+            merged[key] = old_cfg[key]
+    return merged

@@ -1,0 +1,166 @@
+"""CLI entry points (reference: ``/root/reference/sheeprl/cli.py``).
+
+``python -m sheeprl_tpu exp=dreamer_v3 env=atari algo.learning_rate=1e-4`` composes the
+config tree, dispatches to the registered algorithm entrypoint and runs it under a
+device-mesh context.  There is no process-per-device launch (the reference's
+``fabric.launch``, ``cli.py:199``): JAX is single-controller, one process per *host*,
+with all local devices driven through the mesh.
+"""
+
+from __future__ import annotations
+
+import datetime
+import importlib
+import os
+import sys
+import warnings
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.config.core import DotDict, compose, load_config, print_config, save_config
+from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry, get_algorithm, get_evaluation
+from sheeprl_tpu.utils.timer import timer
+
+
+def _import_algorithms() -> None:
+    """Populate the registries (reference imports every algo in ``sheeprl/__init__.py:18-47``)."""
+    import sheeprl_tpu.algos  # noqa: F401  (registers everything on import)
+
+
+def resume_from_checkpoint(cfg: DotDict) -> DotDict:
+    """Merge the checkpoint run's config, protecting training-critical keys
+    (reference ``cli.py:23-58``)."""
+    ckpt_path = Path(cfg.checkpoint.resume_from)
+    run_dir = ckpt_path.parent.parent if ckpt_path.is_dir() else ckpt_path.parent
+    old_cfg_path = run_dir / "config.yaml"
+    if not old_cfg_path.is_file():
+        old_cfg_path = ckpt_path.parent / "config.yaml"
+    if not old_cfg_path.is_file():
+        raise FileNotFoundError(
+            f"Cannot resume from {ckpt_path}: no config.yaml found alongside the checkpoint"
+        )
+    old_cfg = load_config(old_cfg_path)
+    for key in ("env", "algo", "buffer", "distribution", "exp_name", "seed"):
+        if key in old_cfg:
+            cfg[key] = old_cfg[key]
+    cfg.checkpoint.resume_from = str(ckpt_path)
+    return cfg
+
+
+def check_configs(cfg: DotDict) -> None:
+    """Config validation (reference ``cli.py:271-345``)."""
+    algo = cfg.get("algo", {})
+    if not algo or "name" not in algo:
+        raise ValueError("No algorithm selected: choose one with 'exp=<preset>' or 'algo=<name>'")
+    entry = get_algorithm(algo["name"])
+    decoupled = entry["decoupled"]
+    if decoupled and cfg.env.get("sync_env", False) is False and cfg.env.num_envs <= 0:
+        raise ValueError("Decoupled algorithms need at least one environment")
+    cnn_keys = algo.get("cnn_keys", {}).get("encoder", [])
+    mlp_keys = algo.get("mlp_keys", {}).get("encoder", [])
+    if not isinstance(cnn_keys, list) or not isinstance(mlp_keys, list):
+        raise ValueError("algo.cnn_keys.encoder and algo.mlp_keys.encoder must be lists")
+    if cfg.metric.get("log_level", 1) not in (0, 1):
+        raise ValueError(f"Invalid metric.log_level: {cfg.metric.log_level}")
+
+
+def run_algorithm(cfg: DotDict) -> None:
+    """Registry lookup + mesh-context construction + entrypoint call
+    (reference ``cli.py:60-199``)."""
+    from sheeprl_tpu.parallel.mesh import make_mesh_context, maybe_init_distributed
+    from sheeprl_tpu.utils.metric import MetricAggregator
+
+    entry = get_algorithm(cfg.algo.name)
+    maybe_init_distributed(cfg.get("mesh", {}))
+    ctx = make_mesh_context(cfg)
+
+    if cfg.metric.get("disable_timer", False):
+        timer.disabled = True
+    MetricAggregator.disabled = cfg.metric.get("log_level", 1) == 0
+
+    entry["entrypoint"](ctx, cfg)
+
+
+def eval_algorithm(cfg: DotDict) -> None:
+    """Evaluation dispatch (reference ``cli.py:202-268``)."""
+    from sheeprl_tpu.parallel.mesh import make_mesh_context
+
+    ckpt_path = Path(cfg.checkpoint_path)
+    run_dir = ckpt_path.parent.parent if ckpt_path.is_dir() else ckpt_path.parent
+    old_cfg_path = run_dir / "config.yaml"
+    if not old_cfg_path.is_file():
+        old_cfg_path = ckpt_path.parent / "config.yaml"
+    if not old_cfg_path.is_file():
+        raise FileNotFoundError(f"No config.yaml found for checkpoint {ckpt_path}")
+    old_cfg = load_config(old_cfg_path)
+    # Evaluation runs the trained config with run-time knobs from the current one.
+    for key in ("env", "algo", "distribution", "exp_name", "seed", "log_root", "root_dir"):
+        if key in old_cfg:
+            cfg[key] = old_cfg[key]
+    cfg.env.capture_video = bool(cfg.get("capture_video", True))
+    cfg.env.num_envs = 1
+    cfg.run_name = cfg.get("run_name") or _default_run_name(cfg)
+
+    evaluate_fn = get_evaluation(cfg.algo.name)
+    ctx = make_mesh_context(cfg)
+    evaluate_fn(ctx, cfg, str(ckpt_path))
+
+
+def _default_run_name(cfg: Dict[str, Any]) -> str:
+    stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+    return f"{stamp}_{cfg.get('exp_name', 'run')}_{cfg.get('seed', 0)}"
+
+
+def run(args: Optional[List[str]] = None) -> None:
+    """Train entry: ``python -m sheeprl_tpu exp=... key=value ...``"""
+    _import_algorithms()
+    overrides = list(args if args is not None else sys.argv[1:])
+    cfg = compose(overrides=overrides)
+    if cfg.checkpoint.get("resume_from"):
+        cfg = resume_from_checkpoint(cfg)
+    if not cfg.get("run_name"):
+        cfg.run_name = _default_run_name(cfg)
+    check_configs(cfg)
+    if os.environ.get("SHEEPRL_TPU_QUIET", "0") != "1":
+        print_config(cfg)
+    run_algorithm(cfg)
+
+
+def evaluate(args: Optional[List[str]] = None) -> None:
+    """Eval entry: ``python -m sheeprl_tpu.eval checkpoint_path=... [overrides]``"""
+    _import_algorithms()
+    overrides = list(args if args is not None else sys.argv[1:])
+    ckpt = None
+    rest = []
+    for ov in overrides:
+        if ov.startswith("checkpoint_path="):
+            ckpt = ov.split("=", 1)[1]
+        else:
+            rest.append(ov)
+    if ckpt is None:
+        raise ValueError("evaluation requires checkpoint_path=<path>")
+    # The checkpoint's saved config is the base; CLI overrides are applied on top
+    # (reference ``cli.py:369-401``: load ckpt config.yaml + merge).
+    ckpt_path = Path(ckpt)
+    run_dir = ckpt_path.parent.parent if ckpt_path.is_dir() else ckpt_path.parent
+    cfg_path = run_dir / "config.yaml"
+    if not cfg_path.is_file():
+        cfg_path = ckpt_path.parent / "config.yaml"
+    if not cfg_path.is_file():
+        raise FileNotFoundError(f"No config.yaml found alongside checkpoint {ckpt}")
+    cfg = load_config(cfg_path)
+    for ov in rest:
+        if "=" not in ov:
+            raise ValueError(f"Malformed override {ov!r}")
+        key, _, val = ov.partition("=")
+        from sheeprl_tpu.config.core import _parse_value, _set_dotted
+
+        _set_dotted(cfg, key.lstrip("+"), _parse_value(val))
+    cfg = DotDict.wrap(cfg)
+    cfg.checkpoint_path = ckpt
+    eval_algorithm(cfg)
+
+
+def available_algorithms() -> List[str]:
+    _import_algorithms()
+    return sorted(algorithm_registry)

@@ -1,0 +1,707 @@
+"""Host-side replay buffers.
+
+TPU-native re-design of ``/root/reference/sheeprl/data/buffers.py``: storage is numpy
+(optionally memmap) on the host with layout ``[buffer_size, n_envs, ...]``; sampling is
+numpy; ``sample_tensors`` returns **JAX device arrays** (optionally placed with an
+explicit ``sharding`` so the batch lands pre-sharded over a ``data`` mesh axis).  The
+device never touches buffer bookkeeping — all control flow stays on the host, which keeps
+the jitted train step free of dynamic shapes.
+
+Buffer classes and their contracts (mirroring reference ``buffers.py``):
+
+* ``ReplayBuffer`` (``:20-360``) — circular dict-of-ndarray store; uniform sampling with
+  validity masking around the write cursor; ``sample_next_obs`` pairs o/o'.
+* ``SequentialReplayBuffer`` (``:363-526``) — contiguous length-T sequences ignoring
+  episode bounds; output ``[n_samples, sequence_length, batch_size, ...]``.
+* ``EnvIndependentReplayBuffer`` (``:529-743``) — one sub-buffer per env, supporting
+  decoupled adds via ``indices``.
+* ``EpisodeBuffer`` (``:746-1155``) — whole-episode store with open-episode assembly,
+  oldest-episode eviction and ``prioritize_ends`` sampling.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import typing
+import uuid
+from pathlib import Path
+from typing import Any, Dict, Optional, Sequence, Type
+
+import numpy as np
+
+from sheeprl_tpu.utils.memmap import MemmapArray
+
+if typing.TYPE_CHECKING:
+    import jax
+
+
+def _np(v: Any) -> np.ndarray:
+    return v.array if isinstance(v, MemmapArray) else np.asarray(v)
+
+
+class ReplayBuffer:
+    batch_axis: int = 1
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: Optional[os.PathLike] = None,
+        memmap_mode: str = "r+",
+        **kwargs: Any,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+        self._buffer_size = buffer_size
+        self._n_envs = n_envs
+        self._obs_keys = tuple(obs_keys)
+        self._memmap = memmap
+        self._memmap_dir = Path(memmap_dir) if memmap_dir is not None else None
+        self._memmap_mode = memmap_mode
+        if self._memmap:
+            if memmap_mode not in ("r+", "w+", "c", "copyonwrite", "readwrite", "write"):
+                raise ValueError(
+                    "Accepted values for memmap_mode are 'r+', 'readwrite', 'w+', 'write', 'c' or 'copyonwrite'."
+                )
+            if self._memmap_dir is None:
+                raise ValueError("memmap=True requires a `memmap_dir`.")
+            self._memmap_dir.mkdir(parents=True, exist_ok=True)
+        self._buf: Dict[str, np.ndarray | MemmapArray] = {}
+        self._pos = 0
+        self._full = False
+        self._rng = np.random.default_rng()
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def buffer(self) -> Dict[str, np.ndarray]:
+        return {k: _np(v) for k, v in self._buf.items()}
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def full(self) -> bool:
+        return self._full
+
+    @full.setter
+    def full(self, value: bool) -> None:
+        self._full = bool(value)
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def empty(self) -> bool:
+        return (not self._full) and self._pos == 0
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    def __len__(self) -> int:
+        return self._buffer_size if self._full else self._pos
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self._rng = np.random.default_rng(seed)
+
+    # -- storage ------------------------------------------------------------
+    def _init_storage(self, key: str, shape: Sequence[int], dtype: np.dtype) -> None:
+        full_shape = (self._buffer_size, self._n_envs, *shape)
+        if self._memmap:
+            filename = self._memmap_dir / f"{key}.memmap"
+            self._buf[key] = MemmapArray(dtype=dtype, shape=full_shape, mode=self._memmap_mode, filename=filename)
+        else:
+            self._buf[key] = np.zeros(full_shape, dtype=dtype)
+
+    def add(self, data: "ReplayBuffer" | Dict[str, np.ndarray], validate_args: bool = False) -> None:
+        """Append ``[T, n_envs, ...]`` arrays, wrapping circularly (reference ``:193-221``)."""
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if validate_args:
+            if not isinstance(data, dict):
+                raise ValueError(f"`data` must be a dictionary of numpy arrays, got {type(data)}")
+            shapes = {k: np.asarray(v).shape[:2] for k, v in data.items()}
+            if len(set(shapes.values())) > 1:
+                raise RuntimeError(f"Every array in `data` must agree on [T, n_envs]: {shapes}")
+            for k, v in data.items():
+                if np.asarray(v).ndim < 2:
+                    raise RuntimeError(f"`data[{k}]` must have shape [T, n_envs, ...], got {np.asarray(v).shape}")
+                if np.asarray(v).shape[1] != self._n_envs:
+                    raise RuntimeError(f"`data[{k}]` has n_envs={np.asarray(v).shape[1]}, expected {self._n_envs}")
+        first = next(iter(data.values()))
+        steps = np.asarray(first).shape[0]
+        for k, v in data.items():
+            v = np.asarray(v)
+            if k not in self._buf:
+                self._init_storage(k, v.shape[2:], v.dtype)
+            buf = self._buf[k]
+            if steps >= self._buffer_size:
+                # Only the trailing window survives.
+                buf[:] = np.moveaxis(v[-self._buffer_size :], 0, 0)
+                continue
+            idxes = (self._pos + np.arange(steps)) % self._buffer_size
+            buf[idxes] = v
+        if steps >= self._buffer_size:
+            self._pos = 0
+            self._full = True
+        else:
+            new_pos = self._pos + steps
+            if new_pos >= self._buffer_size:
+                self._full = True
+            self._pos = new_pos % self._buffer_size
+
+    # -- sampling -----------------------------------------------------------
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Uniformly sample ``[n_samples, batch_size, ...]`` transitions (reference ``:223-288``)."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be greater than 0")
+        if self.empty:
+            raise ValueError("No sample has been added to the buffer. Please add at least one via `add()`")
+        batch_dim = batch_size * n_samples
+        if self._full:
+            if sample_next_obs:
+                # Exclude _pos - 1: its "next" entry (at _pos) is the oldest element,
+                # i.e. an unrelated transition across the write cursor.
+                idxes = (self._rng.integers(0, self._buffer_size - 1, size=batch_dim) + self._pos) % self._buffer_size
+            else:
+                idxes = self._rng.integers(0, self._buffer_size, size=batch_dim)
+        else:
+            upper = self._pos - 1 if sample_next_obs else self._pos
+            if upper <= 0:
+                raise ValueError("Not enough data to sample next observations")
+            idxes = self._rng.integers(0, upper, size=batch_dim)
+        return self._gather(idxes, batch_size, n_samples, sample_next_obs, clone)
+
+    def _gather(
+        self, idxes: np.ndarray, batch_size: int, n_samples: int, sample_next_obs: bool, clone: bool
+    ) -> Dict[str, np.ndarray]:
+        env_idxes = self._rng.integers(0, self._n_envs, size=idxes.shape[0])
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = _np(v)
+            picked = arr[idxes, env_idxes]
+            out[k] = picked.reshape(n_samples, batch_size, *arr.shape[2:])
+            if clone:
+                out[k] = out[k].copy()
+            if sample_next_obs and k in self._obs_keys:
+                nxt = arr[(idxes + 1) % self._buffer_size, env_idxes]
+                out[f"next_{k}"] = nxt.reshape(n_samples, batch_size, *arr.shape[2:])
+                if clone:
+                    out[f"next_{k}"] = out[f"next_{k}"].copy()
+        return out
+
+    def sample_tensors(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        dtype: Optional[Any] = None,
+        sharding: Optional["jax.sharding.Sharding"] = None,
+        **kwargs: Any,
+    ) -> Dict[str, "jax.Array"]:
+        """Sample and move to device (reference ``sample_tensors`` ``:291-326``)."""
+        samples = self.sample(batch_size=batch_size, sample_next_obs=sample_next_obs, n_samples=n_samples, **kwargs)
+        return to_device(samples, dtype=dtype, sharding=sharding)
+
+    def to_tensor(self, dtype: Optional[Any] = None, clone: bool = False, **kwargs: Any) -> Dict[str, "jax.Array"]:
+        return to_device({k: _np(v).copy() if clone else _np(v) for k, v in self._buf.items()}, dtype=dtype)
+
+    # -- dict access --------------------------------------------------------
+    def __getitem__(self, key: str) -> np.ndarray:
+        if not isinstance(key, str):
+            raise TypeError("ReplayBuffer keys must be strings")
+        return _np(self._buf[key])
+
+    def __setitem__(self, key: str, value: np.ndarray) -> None:
+        value = np.asarray(value)
+        if value.shape[:2] != (self._buffer_size, self._n_envs):
+            raise RuntimeError(
+                f"Value shape {value.shape} incompatible with buffer [{self._buffer_size}, {self._n_envs}, ...]"
+            )
+        if key not in self._buf:
+            self._init_storage(key, value.shape[2:], value.dtype)
+        self._buf[key][:] = value
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._buf
+
+    # -- checkpoint state ---------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "buffer": {k: _np(v).copy() for k, v in self._buf.items()},
+            "pos": self._pos,
+            "full": self._full,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "ReplayBuffer":
+        for k, v in state["buffer"].items():
+            if k not in self._buf:
+                self._init_storage(k, v.shape[2:], v.dtype)
+            self._buf[k][:] = v
+        self._pos = state["pos"]
+        self._full = state["full"]
+        return self
+
+
+class SequentialReplayBuffer(ReplayBuffer):
+    """Contiguous-sequence sampling, ignoring episode boundaries (reference ``:363-526``)."""
+
+    batch_axis: int = 2
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        sequence_length: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be greater than 0")
+        if self.empty:
+            raise ValueError("No sample has been added to the buffer. Please add at least one via `add()`")
+        if not self._full and self._pos - sequence_length + 1 < 1:
+            raise ValueError(
+                f"Cannot sample a sequence of length {sequence_length}. Data added so far: {self._pos}"
+            )
+        if self._full and sequence_length > len(self):
+            raise ValueError(f"Sequence length ({sequence_length}) longer than buffer ({len(self)})")
+        batch_dim = batch_size * n_samples
+        if self._full:
+            # Valid starts are those whose sequence does not cross the write cursor:
+            # [0, pos - seq_len] ∪ [pos, end-of-wrappable-range]  (reference ``:439-456``)
+            first_range_end = self._pos - sequence_length + 1
+            second_range_end = self._buffer_size if first_range_end >= 0 else self._buffer_size + first_range_end
+            valid = np.concatenate(
+                [np.arange(0, max(first_range_end, 0)), np.arange(self._pos, second_range_end)]
+            ).astype(np.intp)
+            starts = valid[self._rng.integers(0, len(valid), size=batch_dim)]
+        else:
+            starts = self._rng.integers(0, self._pos - sequence_length + 1, size=batch_dim)
+        offsets = np.arange(sequence_length, dtype=np.intp)[None, :]
+        idxes = (starts[:, None] + offsets) % self._buffer_size  # [B*N, T]
+        return self._gather_sequences(idxes, batch_size, n_samples, sequence_length, sample_next_obs, clone)
+
+    def _gather_sequences(
+        self,
+        idxes: np.ndarray,
+        batch_size: int,
+        n_samples: int,
+        sequence_length: int,
+        sample_next_obs: bool,
+        clone: bool,
+    ) -> Dict[str, np.ndarray]:
+        batch_dim = batch_size * n_samples
+        # One environment per sequence.
+        env_idxes = self._rng.integers(0, self._n_envs, size=batch_dim)
+        env_idxes_tiled = np.repeat(env_idxes[:, None], sequence_length, axis=1)
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = _np(v)
+            picked = arr[idxes.ravel(), env_idxes_tiled.ravel()]
+            picked = picked.reshape(n_samples, batch_size, sequence_length, *arr.shape[2:])
+            out[k] = np.swapaxes(picked, 1, 2)  # [n_samples, T, B, ...]
+            if clone:
+                out[k] = out[k].copy()
+            if sample_next_obs and k in self._obs_keys:
+                nxt = arr[(idxes.ravel() + 1) % self._buffer_size, env_idxes_tiled.ravel()]
+                nxt = nxt.reshape(n_samples, batch_size, sequence_length, *arr.shape[2:])
+                out[f"next_{k}"] = np.swapaxes(nxt, 1, 2)
+                if clone:
+                    out[f"next_{k}"] = out[f"next_{k}"].copy()
+        return out
+
+
+class EnvIndependentReplayBuffer:
+    """One sub-buffer per environment (reference ``:529-743``)."""
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: Optional[os.PathLike] = None,
+        memmap_mode: str = "r+",
+        buffer_cls: Type[ReplayBuffer] = ReplayBuffer,
+        **kwargs: Any,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+        if memmap and memmap_dir is None:
+            raise ValueError("memmap=True requires a `memmap_dir`.")
+        self._n_envs = n_envs
+        self._buffer_size = buffer_size
+        self._buffer_cls = buffer_cls
+        self._concat_along_axis = buffer_cls.batch_axis
+        self._buf: Sequence[ReplayBuffer] = [
+            buffer_cls(
+                buffer_size=buffer_size,
+                n_envs=1,
+                obs_keys=obs_keys,
+                memmap=memmap,
+                memmap_dir=None if memmap_dir is None else Path(memmap_dir) / f"env_{i}",
+                memmap_mode=memmap_mode,
+                **kwargs,
+            )
+            for i in range(n_envs)
+        ]
+        self._rng = np.random.default_rng()
+
+    @property
+    def buffer(self) -> Sequence[ReplayBuffer]:
+        return self._buf
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def full(self) -> Sequence[bool]:
+        return [b.full for b in self._buf]
+
+    @property
+    def empty(self) -> Sequence[bool]:
+        return [b.empty for b in self._buf]
+
+    @property
+    def is_memmap(self) -> Sequence[bool]:
+        return [b.is_memmap for b in self._buf]
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self._buf)
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self._rng = np.random.default_rng(seed)
+        for i, b in enumerate(self._buf):
+            b.seed(None if seed is None else seed + i)
+
+    def add(self, data: Dict[str, np.ndarray], indices: Optional[Sequence[int]] = None, validate_args: bool = False) -> None:
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if indices is None:
+            indices = tuple(range(self._n_envs))
+        if validate_args and len(indices) != next(iter(data.values())).shape[1]:
+            raise ValueError("`indices` must match data's env dimension")
+        for i, env_idx in enumerate(indices):
+            self._buf[env_idx].add({k: np.asarray(v)[:, i : i + 1] for k, v in data.items()}, validate_args=validate_args)
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be greater than 0")
+        # Split the batch uniformly across non-empty sub-buffers (reference ``:684-699``).
+        valid = [i for i, b in enumerate(self._buf) if len(b) > 0]
+        if not valid:
+            raise ValueError("No sample has been added to the buffer.")
+        picks = self._rng.integers(0, len(valid), size=batch_size)
+        counts = np.bincount(picks, minlength=len(valid))
+        parts = []
+        for j, i in enumerate(valid):
+            if counts[j] > 0:
+                parts.append(
+                    self._buf[i].sample(
+                        batch_size=int(counts[j]),
+                        sample_next_obs=sample_next_obs,
+                        clone=clone,
+                        n_samples=n_samples,
+                        **kwargs,
+                    )
+                )
+        keys = parts[0].keys()
+        return {k: np.concatenate([p[k] for p in parts], axis=self._concat_along_axis) for k in keys}
+
+    def sample_tensors(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        dtype: Optional[Any] = None,
+        sharding: Optional["jax.sharding.Sharding"] = None,
+        **kwargs: Any,
+    ) -> Dict[str, "jax.Array"]:
+        samples = self.sample(batch_size=batch_size, sample_next_obs=sample_next_obs, n_samples=n_samples, **kwargs)
+        return to_device(samples, dtype=dtype, sharding=sharding)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"buffers": [b.state_dict() for b in self._buf]}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "EnvIndependentReplayBuffer":
+        for b, s in zip(self._buf, state["buffers"]):
+            b.load_state_dict(s)
+        return self
+
+
+class EpisodeBuffer:
+    """Whole-episode store (reference ``:746-1155``)."""
+
+    batch_axis: int = 2
+
+    def __init__(
+        self,
+        buffer_size: int,
+        minimum_episode_length: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        prioritize_ends: bool = False,
+        memmap: bool = False,
+        memmap_dir: Optional[os.PathLike] = None,
+        memmap_mode: str = "r+",
+        **kwargs: Any,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if minimum_episode_length <= 0:
+            raise ValueError(f"The minimum episode length must be greater than zero, got: {minimum_episode_length}")
+        if buffer_size < minimum_episode_length:
+            raise ValueError(
+                f"The minimum episode length must be lower than the buffer size, got: bs={buffer_size} ml={minimum_episode_length}"
+            )
+        self._buffer_size = buffer_size
+        self._minimum_episode_length = minimum_episode_length
+        self._n_envs = n_envs
+        self._obs_keys = tuple(obs_keys)
+        self._prioritize_ends = prioritize_ends
+        self._memmap = memmap
+        self._memmap_dir = Path(memmap_dir) if memmap_dir is not None else None
+        self._memmap_mode = memmap_mode
+        if memmap and self._memmap_dir is None:
+            raise ValueError("memmap=True requires a `memmap_dir`.")
+        if self._memmap_dir is not None:
+            self._memmap_dir.mkdir(parents=True, exist_ok=True)
+        self._open_episodes: Sequence[list] = [[] for _ in range(n_envs)]
+        self._cum_lengths: list = []
+        self._buf: list = []
+        self._rng = np.random.default_rng()
+
+    @property
+    def buffer(self) -> Sequence[Dict[str, np.ndarray]]:
+        return self._buf
+
+    @property
+    def obs_keys(self) -> Sequence[str]:
+        return self._obs_keys
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def minimum_episode_length(self) -> int:
+        return self._minimum_episode_length
+
+    @property
+    def prioritize_ends(self) -> bool:
+        return self._prioritize_ends
+
+    @prioritize_ends.setter
+    def prioritize_ends(self, value: bool) -> None:
+        self._prioritize_ends = bool(value)
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    @property
+    def full(self) -> bool:
+        return len(self) + self._minimum_episode_length > self._buffer_size
+
+    def __len__(self) -> int:
+        return self._cum_lengths[-1] if self._cum_lengths else 0
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self._rng = np.random.default_rng(seed)
+
+    def add(
+        self,
+        data: "ReplayBuffer" | Dict[str, np.ndarray],
+        env_idxes: Optional[Sequence[int]] = None,
+        validate_args: bool = False,
+    ) -> None:
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if validate_args:
+            if not isinstance(data, dict):
+                raise ValueError(f"`data` must be a dictionary of numpy arrays, got {type(data)}")
+            if "terminated" not in data or "truncated" not in data:
+                raise RuntimeError(f"data must contain `terminated` and `truncated` keys, got: {list(data)}")
+            if env_idxes is not None and (np.asarray(env_idxes) >= self._n_envs).any():
+                raise ValueError(f"env indices must be in [0, {self._n_envs}), given {env_idxes}")
+        if env_idxes is None:
+            env_idxes = range(self._n_envs)
+        for i, env in enumerate(env_idxes):
+            env_data = {k: np.asarray(v)[:, i] for k, v in data.items()}
+            done = np.logical_or(env_data["terminated"], env_data["truncated"]).reshape(-1)
+            ends = done.nonzero()[0].tolist()
+            if not ends:
+                self._open_episodes[env].append(env_data)
+                continue
+            start = 0
+            for end in ends + ([len(done) - 1] if ends[-1] != len(done) - 1 else []):
+                chunk = {k: v[start : end + 1] for k, v in env_data.items()}
+                if len(next(iter(chunk.values()))) > 0:
+                    self._open_episodes[env].append(chunk)
+                start = end + 1
+                last = self._open_episodes[env][-1] if self._open_episodes[env] else None
+                if last is not None and bool(np.logical_or(last["terminated"][-1], last["truncated"][-1]).any()):
+                    self._save_episode(self._open_episodes[env])
+                    self._open_episodes[env] = []
+
+    def _save_episode(self, chunks: Sequence[Dict[str, np.ndarray]]) -> None:
+        if not chunks:
+            raise RuntimeError("Invalid episode: an empty sequence was given.")
+        episode = {k: np.concatenate([c[k] for c in chunks], axis=0) for k in chunks[0]}
+        ends = np.logical_or(episode["terminated"], episode["truncated"]).reshape(-1)
+        ep_len = ends.shape[0]
+        if ends.nonzero()[0].size != 1 or not ends[-1]:
+            raise RuntimeError("The episode must contain exactly one done at its last step")
+        if ep_len < self._minimum_episode_length:
+            raise RuntimeError(f"Episode too short (min {self._minimum_episode_length}), got {ep_len} steps")
+        if ep_len > self._buffer_size:
+            raise RuntimeError(f"Episode too long (max {self._buffer_size}), got {ep_len} steps")
+        # Evict oldest episodes until the new one fits (reference ``:994-1014``).
+        while self._buf and len(self) + ep_len > self._buffer_size:
+            evicted = self._buf.pop(0)
+            self._cum_lengths = [c - self._cum_lengths[0] for c in self._cum_lengths[1:]]
+            if self._memmap:
+                dirname = os.path.dirname(next(iter(evicted.values())).filename)
+                for v in evicted.values():
+                    v.has_ownership = True
+                evicted.clear()
+                shutil.rmtree(dirname, ignore_errors=True)
+        if self._memmap:
+            ep_dir = self._memmap_dir / f"episode_{uuid.uuid4().hex}"
+            episode = {k: MemmapArray.from_array(v, filename=ep_dir / f"{k}.memmap") for k, v in episode.items()}
+        self._buf.append(episode)
+        self._cum_lengths.append(len(self) + ep_len)
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        clone: bool = False,
+        sequence_length: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Sample ``[n_samples, sequence_length, batch_size, ...]`` (reference ``:1033-1120``)."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be greater than 0")
+        lengths = np.diff([0] + self._cum_lengths)
+        min_len = sequence_length + (1 if sample_next_obs else 0)
+        valid = [ep for ep, ln in zip(self._buf, lengths) if ln >= min_len and (not sample_next_obs or ln > sequence_length)]
+        if not valid:
+            raise RuntimeError(
+                "No valid episodes in the buffer; add at least one episode of length >= "
+                f"{sequence_length}."
+            )
+        batch_dim = batch_size * n_samples
+        ep_choice = self._rng.integers(0, len(valid), size=batch_dim)
+        offsets = np.arange(sequence_length, dtype=np.intp)[None, :]
+        parts: Dict[str, list] = {k: [] for k in valid[0].keys()}
+        if sample_next_obs:
+            for k in self._obs_keys:
+                parts[f"next_{k}"] = []
+        for b in range(batch_dim):
+            ep = valid[ep_choice[b]]
+            ep_len = _np(ep["terminated"]).shape[0]
+            if sample_next_obs:
+                ep_len -= 1
+            upper = ep_len - sequence_length + 1
+            if self._prioritize_ends:
+                upper += sequence_length
+            start = min(int(self._rng.integers(0, upper)), ep_len - sequence_length)
+            idx = start + offsets[0]
+            for k in ep.keys():
+                parts[k].append(_np(ep[k])[idx])
+                if sample_next_obs and k in self._obs_keys:
+                    parts[f"next_{k}"].append(_np(ep[k])[idx + 1])
+        out = {}
+        for k, v in parts.items():
+            if v:
+                stacked = np.stack(v, axis=0).reshape(n_samples, batch_size, sequence_length, *v[0].shape[1:])
+                out[k] = np.swapaxes(stacked, 1, 2)
+                if clone:
+                    out[k] = out[k].copy()
+        return out
+
+    def sample_tensors(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        dtype: Optional[Any] = None,
+        sharding: Optional["jax.sharding.Sharding"] = None,
+        **kwargs: Any,
+    ) -> Dict[str, "jax.Array"]:
+        samples = self.sample(batch_size=batch_size, sample_next_obs=sample_next_obs, n_samples=n_samples, **kwargs)
+        return to_device(samples, dtype=dtype, sharding=sharding)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "episodes": [{k: _np(v).copy() for k, v in ep.items()} for ep in self._buf],
+            "cum_lengths": list(self._cum_lengths),
+            "open_episodes": [[{k: np.asarray(v).copy() for k, v in c.items()} for c in chunks] for chunks in self._open_episodes],
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "EpisodeBuffer":
+        self._buf = []
+        self._cum_lengths = []
+        for ep in state["episodes"]:
+            if self._memmap:
+                ep_dir = self._memmap_dir / f"episode_{uuid.uuid4().hex}"
+                ep = {k: MemmapArray.from_array(v, filename=ep_dir / f"{k}.memmap") for k, v in ep.items()}
+            self._buf.append(ep)
+            ln = next(iter(ep.values())).shape[0]
+            self._cum_lengths.append((self._cum_lengths[-1] if self._cum_lengths else 0) + ln)
+        self._open_episodes = state["open_episodes"]
+        return self
+
+
+def to_device(
+    samples: Dict[str, np.ndarray],
+    dtype: Optional[Any] = None,
+    sharding: Optional["jax.sharding.Sharding"] = None,
+) -> Dict[str, "jax.Array"]:
+    """Host→device transfer of a sample dict, optionally pre-sharded over a mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    for k, v in samples.items():
+        arr = np.asarray(v)
+        if dtype is not None and np.issubdtype(arr.dtype, np.floating):
+            arr = arr.astype(dtype)
+        out[k] = jax.device_put(arr, sharding) if sharding is not None else jnp.asarray(arr)
+    return out

@@ -1,0 +1,392 @@
+"""Distributions as lightweight JAX containers.
+
+The reference uses ``torch.distributions`` subclasses
+(``/root/reference/sheeprl/utils/distribution.py``); on TPU these become plain classes
+holding logits/params, with ``log_prob`` / ``entropy`` / ``sample`` / ``mode`` as pure
+jnp functions — created and consumed entirely inside a jitted trace, so there is nothing
+to register as a pytree.
+
+Provided (reference line cites):
+
+* ``Normal``, ``Independent`` — standard building blocks.
+* ``TanhNormal`` — tanh-squashed Gaussian with log-det correction (SAC actor,
+  reference ``algos/sac/agent.py:57-…``).
+* ``TruncatedNormal`` — ``distribution.py:116``.
+* ``Categorical`` / ``OneHotCategorical`` / ``OneHotCategoricalStraightThrough`` —
+  ``distribution.py:281,387``; straight-through gradients via ``sample + p - sg(p)``.
+* ``TwoHotEncodingDistribution`` — symlog-space 255-bin two-hot, ``distribution.py:253-276``.
+* ``SymlogDistribution`` — ``distribution.py:152``; ``MSEDistribution`` — ``:196``.
+* ``BernoulliSafeMode`` — ``:409``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.utils import symexp, symlog, two_hot_decoder, two_hot_encoder
+
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
+
+
+class Distribution:
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        raise NotImplementedError
+
+    @property
+    def mode(self) -> jax.Array:
+        raise NotImplementedError
+
+    @property
+    def mean(self) -> jax.Array:
+        raise NotImplementedError
+
+    def entropy(self) -> jax.Array:
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc: jax.Array, scale: jax.Array):
+        self.loc = loc
+        self.scale = scale
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        var = self.scale**2
+        return -((x - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - _HALF_LOG_2PI
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = sample_shape + self.loc.shape
+        return self.loc + self.scale * jax.random.normal(key, shape, dtype=self.loc.dtype)
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        return self.sample(key)
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.loc
+
+    def entropy(self) -> jax.Array:
+        return 0.5 + _HALF_LOG_2PI + jnp.log(self.scale)
+
+    def kl_divergence(self, other: "Normal") -> jax.Array:
+        # KL(self || other)
+        return (
+            jnp.log(other.scale / self.scale)
+            + (self.scale**2 + (self.loc - other.loc) ** 2) / (2 * other.scale**2)
+            - 0.5
+        )
+
+
+class Independent(Distribution):
+    """Sum log-probs over the trailing ``reinterpreted_batch_ndims`` event dims."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_ndims: int = 1):
+        self.base = base
+        self.ndims = reinterpreted_batch_ndims
+
+    def _reduce(self, x: jax.Array) -> jax.Array:
+        if self.ndims == 0:
+            return x
+        return x.sum(axis=tuple(range(-self.ndims, 0)))
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        return self._reduce(self.base.log_prob(x))
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        return self.base.sample(key, sample_shape)
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        return self.base.rsample(key)
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.base.mode
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.base.mean
+
+    def entropy(self) -> jax.Array:
+        return self._reduce(self.base.entropy())
+
+
+class TanhNormal(Distribution):
+    """tanh-squashed Gaussian with change-of-variables log-prob (SAC actor)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, eps: float = 1e-6):
+        self.base = Normal(loc, scale)
+        self.eps = eps
+
+    def sample_and_log_prob(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        pre = self.base.sample(key)
+        act = jnp.tanh(pre)
+        # log det of tanh: log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x)) (stable form)
+        log_det = 2.0 * (math.log(2.0) - pre - jax.nn.softplus(-2.0 * pre))
+        logp = self.base.log_prob(pre) - log_det
+        return act, logp
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        return jnp.tanh(self.base.sample(key, sample_shape))
+
+    def log_prob(self, a: jax.Array) -> jax.Array:
+        a = jnp.clip(a, -1 + self.eps, 1 - self.eps)
+        pre = jnp.arctanh(a)
+        log_det = 2.0 * (math.log(2.0) - pre - jax.nn.softplus(-2.0 * pre))
+        return self.base.log_prob(pre) - log_det
+
+    @property
+    def mode(self) -> jax.Array:
+        return jnp.tanh(self.base.loc)
+
+    @property
+    def mean(self) -> jax.Array:
+        return jnp.tanh(self.base.loc)
+
+
+class TruncatedNormal(Distribution):
+    """Normal truncated to ``[low, high]`` (reference ``distribution.py:116``); sampling
+    via clipped reparameterisation (the reference's ``sample_mean + clip`` behavior)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, low: float = -1.0, high: float = 1.0, eps: float = 1e-6):
+        self.loc = loc
+        self.scale = scale
+        self.low = low
+        self.high = high
+        self.eps = eps
+
+    def _clamp(self, x: jax.Array) -> jax.Array:
+        clamped = jnp.clip(x, self.low + self.eps, self.high - self.eps)
+        return x + jax.lax.stop_gradient(clamped - x)
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = sample_shape + self.loc.shape
+        # inverse-CDF truncated sampling
+        a = (self.low - self.loc) / self.scale
+        b = (self.high - self.loc) / self.scale
+        cdf_a = jax.scipy.stats.norm.cdf(a)
+        cdf_b = jax.scipy.stats.norm.cdf(b)
+        u = jax.random.uniform(key, shape, dtype=self.loc.dtype, minval=1e-5, maxval=1 - 1e-5)
+        p = cdf_a + u * (cdf_b - cdf_a)
+        x = self.loc + self.scale * jax.scipy.stats.norm.ppf(p)
+        return self._clamp(x)
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        return self.sample(key)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        a = (self.low - self.loc) / self.scale
+        b = (self.high - self.loc) / self.scale
+        z = jax.scipy.stats.norm.cdf(b) - jax.scipy.stats.norm.cdf(a)
+        logp = Normal(self.loc, self.scale).log_prob(x) - jnp.log(z + 1e-8)
+        return logp
+
+    @property
+    def mode(self) -> jax.Array:
+        return jnp.clip(self.loc, self.low, self.high)
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.mode
+
+    def entropy(self) -> jax.Array:
+        return Normal(self.loc, self.scale).entropy()
+
+
+class Categorical(Distribution):
+    def __init__(self, logits: jax.Array):
+        self.logits = jax.nn.log_softmax(logits, axis=-1)
+
+    @property
+    def probs(self) -> jax.Array:
+        return jnp.exp(self.logits)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        x = x.astype(jnp.int32)
+        return jnp.take_along_axis(self.logits, x[..., None], axis=-1)[..., 0]
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        return jax.random.categorical(key, self.logits, axis=-1, shape=sample_shape + self.logits.shape[:-1])
+
+    @property
+    def mode(self) -> jax.Array:
+        return jnp.argmax(self.logits, axis=-1)
+
+    def entropy(self) -> jax.Array:
+        return -(self.probs * self.logits).sum(-1)
+
+
+class OneHotCategorical(Categorical):
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        idx = super().sample(key, sample_shape)
+        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.logits.dtype)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        return (self.logits * x).sum(-1)
+
+    @property
+    def mode(self) -> jax.Array:
+        return jax.nn.one_hot(jnp.argmax(self.logits, axis=-1), self.logits.shape[-1], dtype=self.logits.dtype)
+
+
+class OneHotCategoricalStraightThrough(OneHotCategorical):
+    """Sample is one-hot forward, ``probs`` gradient backward (reference
+    ``distribution.py:387-401``) — the stop-gradient placement IS the algorithm."""
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        hard = self.sample(key)
+        probs = self.probs
+        return hard + probs - jax.lax.stop_gradient(probs)
+
+
+def unimix_logits(logits: jax.Array, unimix: float = 0.01) -> jax.Array:
+    """Mix 1% uniform into the categorical (DreamerV3; reference
+    ``algos/dreamer_v3/agent.py:437-449``)."""
+    if unimix <= 0:
+        return logits
+    probs = jax.nn.softmax(logits, axis=-1)
+    uniform = jnp.ones_like(probs) / probs.shape[-1]
+    probs = (1 - unimix) * probs + unimix * uniform
+    return jnp.log(probs)
+
+
+class TwoHotEncodingDistribution(Distribution):
+    """Symlog-space two-hot distribution over a fixed support (reference
+    ``distribution.py:222-276``).  ``logits``: ``[..., bins]``; values decode via
+    symexp of the support expectation."""
+
+    def __init__(self, logits: jax.Array, dims: int = 0, low: float = -20.0, high: float = 20.0):
+        self.logits = jax.nn.log_softmax(logits, axis=-1)
+        self.dims = dims
+        self.low = low
+        self.high = high
+        self.bins = logits.shape[-1]
+
+    @property
+    def mean(self) -> jax.Array:
+        probs = jnp.exp(self.logits)
+        support = jnp.linspace(self.low, self.high, self.bins, dtype=self.logits.dtype)
+        return symexp((probs * support).sum(-1, keepdims=True))
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.mean
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        # x: [..., 1] raw-space scalar.
+        target = two_hot_encoder(symlog(x), support_range=int(self.high), num_buckets=self.bins)
+        lp = (target * self.logits).sum(-1, keepdims=True)
+        if self.dims:
+            lp = lp.sum(axis=tuple(range(-self.dims, 0)))
+        return lp
+
+
+class SymlogDistribution(Distribution):
+    """-MSE in symlog space as a log-prob (reference ``distribution.py:152-193``)."""
+
+    def __init__(self, loc: jax.Array, dims: int = 1, agg: str = "sum"):
+        self.loc = loc
+        self.dims = dims
+        self.agg = agg
+
+    @property
+    def mode(self) -> jax.Array:
+        return symexp(self.loc)
+
+    @property
+    def mean(self) -> jax.Array:
+        return symexp(self.loc)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        dist = -((self.loc - symlog(x)) ** 2)
+        if self.dims == 0:
+            return dist
+        axes = tuple(range(-self.dims, 0))
+        return dist.sum(axes) if self.agg == "sum" else dist.mean(axes)
+
+
+class MSEDistribution(Distribution):
+    def __init__(self, loc: jax.Array, dims: int = 1, agg: str = "sum"):
+        self.loc = loc
+        self.dims = dims
+        self.agg = agg
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.loc
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        dist = -((self.loc - x) ** 2)
+        if self.dims == 0:
+            return dist
+        axes = tuple(range(-self.dims, 0))
+        return dist.sum(axes) if self.agg == "sum" else dist.mean(axes)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, logits: jax.Array):
+        self.logits = logits
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.sigmoid(self.logits)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        return -jnp.maximum(self.logits, 0) + self.logits * x - jnp.log1p(jnp.exp(-jnp.abs(self.logits)))
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = sample_shape + self.logits.shape
+        return (jax.random.uniform(key, shape) < self.probs).astype(self.logits.dtype)
+
+    def entropy(self) -> jax.Array:
+        p = self.probs
+        return -(p * jnp.log(p + 1e-8) + (1 - p) * jnp.log(1 - p + 1e-8))
+
+
+class BernoulliSafeMode(Bernoulli):
+    """Bernoulli whose mode never NaNs at p=0.5 (reference ``distribution.py:409``)."""
+
+    @property
+    def mode(self) -> jax.Array:
+        return (self.probs > 0.5).astype(self.logits.dtype)
+
+
+class MultiCategorical(Distribution):
+    """Tuple of independent categoricals over split logits (MultiDiscrete actions)."""
+
+    def __init__(self, logits: jax.Array, nvec: Sequence[int]):
+        self.nvec = tuple(int(n) for n in nvec)
+        splits = []
+        offset = 0
+        for n in self.nvec:
+            splits.append(Categorical(logits[..., offset : offset + n]))
+            offset += n
+        self.dists = splits
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        # x: [..., len(nvec)] integer actions
+        return sum(d.log_prob(x[..., i]) for i, d in enumerate(self.dists))
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        keys = jax.random.split(key, len(self.dists))
+        return jnp.stack([d.sample(k, sample_shape) for d, k in zip(self.dists, keys)], axis=-1)
+
+    @property
+    def mode(self) -> jax.Array:
+        return jnp.stack([d.mode for d in self.dists], axis=-1)
+
+    def entropy(self) -> jax.Array:
+        return sum(d.entropy() for d in self.dists)

@@ -1,0 +1,103 @@
+"""Deterministic dummy environments — the CI workhorse.
+
+Same contract as the reference (``/root/reference/sheeprl/envs/dummy.py:8-108``): dict
+observation {rgb: uint8 [C,H,W], state: float} (or vector-only), fixed episode length,
+frames filled with the step counter so pipelines are bit-checkable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+
+class _DummyEnv(gym.Env):
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+        dict_obs_space: bool = True,
+    ):
+        self._dict_obs_space = dict_obs_space
+        if dict_obs_space:
+            self.observation_space = gym.spaces.Dict(
+                {
+                    "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
+                    "state": gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32),
+                }
+            )
+        else:
+            self.observation_space = gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32)
+        self.reward_range = (-np.inf, np.inf)
+        self._current_step = 0
+        self._n_steps = n_steps
+
+    def _get_obs(self):
+        if self._dict_obs_space:
+            return {
+                "rgb": np.full(self.observation_space["rgb"].shape, self._current_step % 256, dtype=np.uint8),
+                "state": np.full(self.observation_space["state"].shape, self._current_step, dtype=np.float32),
+            }
+        return np.full(self.observation_space.shape, self._current_step, dtype=np.float32)
+
+    def step(self, action):
+        done = self._current_step == self._n_steps
+        self._current_step += 1
+        return self._get_obs(), 0.0, done, False, {}
+
+    def reset(self, seed: Optional[int] = None, options=None):
+        super().reset(seed=seed)
+        self._current_step = 0
+        return self._get_obs(), {}
+
+    def render(self):
+        if self._dict_obs_space:
+            return np.transpose(self._get_obs()["rgb"], (1, 2, 0))
+        return np.zeros((64, 64, 3), dtype=np.uint8)
+
+    def close(self):
+        pass
+
+
+class ContinuousDummyEnv(_DummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+        action_dim: int = 2,
+        dict_obs_space: bool = True,
+    ):
+        self.action_space = gym.spaces.Box(-1.0, 1.0, shape=(action_dim,), dtype=np.float32)
+        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape, dict_obs_space=dict_obs_space)
+
+
+class DiscreteDummyEnv(_DummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 4,
+        vector_shape: Tuple[int, ...] = (10,),
+        action_dim: int = 2,
+        dict_obs_space: bool = True,
+    ):
+        self.action_space = gym.spaces.Discrete(action_dim)
+        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape, dict_obs_space=dict_obs_space)
+
+
+class MultiDiscreteDummyEnv(_DummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+        action_dims: List[int] = [2, 2],
+        dict_obs_space: bool = True,
+    ):
+        self.action_space = gym.spaces.MultiDiscrete(action_dims)
+        super().__init__(image_size=image_size, n_steps=n_steps, vector_shape=vector_shape, dict_obs_space=dict_obs_space)

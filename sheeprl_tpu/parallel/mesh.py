@@ -1,0 +1,167 @@
+"""Device-mesh context: the TPU-native replacement for Lightning Fabric.
+
+The reference's L0 substrate is ``Fabric(devices, strategy, accelerator, precision)``
+plus NCCL collectives (``/root/reference/sheeprl/cli.py:101,149``).  Here the substrate
+is a ``jax.sharding.Mesh`` over ICI/DCN:
+
+* data parallelism = shard the batch over the ``data`` axis; XLA/GSPMD inserts the
+  gradient ``psum`` when params are replicated and the loss is a global mean;
+* an optional ``model`` (tensor-parallel) axis is free with GSPMD sharding rules —
+  something the reference never had (SURVEY §2.4);
+* multi-host runs initialise ``jax.distributed`` and the same code path scales over DCN.
+
+``MeshContext`` carries mesh + shardings + precision policy + process topology, and is
+passed to every algorithm ``main`` the way ``fabric`` is in the reference.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def maybe_init_distributed(cfg: Dict[str, Any]) -> None:
+    """Initialise multi-host JAX when requested (replaces Fabric ``num_nodes``)."""
+    dist = cfg.get("distributed", {}) or {}
+    if dist.get("coordinator_address"):
+        jax.distributed.initialize(
+            coordinator_address=dist["coordinator_address"],
+            num_processes=dist.get("num_processes"),
+            process_id=dist.get("process_id"),
+        )
+
+
+def build_mesh(
+    data: int = -1,
+    model: int = 1,
+    sequence: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(data, model, sequence)`` mesh. ``data=-1`` consumes remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fixed = model * sequence
+    if data == -1:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by model*sequence={fixed}")
+        data = n // fixed
+    if data * model * sequence != n:
+        raise ValueError(f"mesh {data}x{model}x{sequence} != {n} devices")
+    dev_array = np.asarray(devices).reshape(data, model, sequence)
+    return Mesh(dev_array, axis_names=("data", "model", "sequence"))
+
+
+@dataclass
+class MeshContext:
+    mesh: Mesh
+    precision: str = "bf16-mixed"
+    seed: int = 42
+    _rng_key: Optional[jax.Array] = field(default=None, repr=False)
+
+    # -- topology -----------------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.mesh.shape["data"]
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return jax.process_index() == 0
+
+    @property
+    def device(self) -> jax.Device:
+        return self.mesh.devices.flat[0]
+
+    # -- precision ----------------------------------------------------------
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        if self.precision in ("bf16-mixed", "bf16-true", "bf16"):
+            return jnp.bfloat16
+        if self.precision in ("16-mixed", "fp16"):
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def param_dtype(self) -> jnp.dtype:
+        # "-true" stores params in the low-precision dtype as well.
+        if self.precision == "bf16-true":
+            return jnp.bfloat16
+        return jnp.float32
+
+    # -- shardings ----------------------------------------------------------
+    def sharding(self, *spec: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.sharding()
+
+    def batch_sharding(self, batch_axis: int = 0) -> NamedSharding:
+        """Shard the given axis over 'data', replicate the rest."""
+        spec = [None] * batch_axis + ["data"]
+        return self.sharding(*spec)
+
+    def shard_batch(self, tree: Any, batch_axis: int = 0) -> Any:
+        sh = self.batch_sharding(batch_axis)
+        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+    def replicate(self, tree: Any) -> Any:
+        return jax.device_put(tree, self.replicated)
+
+    # -- rng ----------------------------------------------------------------
+    def rng(self) -> jax.Array:
+        """Split a fresh PRNG key off the context's chain (host-side bookkeeping)."""
+        if self._rng_key is None:
+            self._rng_key = jax.random.PRNGKey(self.seed + jax.process_index())
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    # -- host-object exchange (reference: TorchCollective over gloo) --------
+    def broadcast_obj(self, obj: Any) -> Any:
+        if jax.process_count() == 1:
+            return obj
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(obj)
+
+    def barrier(self) -> None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("sheeprl_tpu_barrier")
+
+    @contextlib.contextmanager
+    def default_mesh(self):
+        with jax.sharding.use_mesh(self.mesh):
+            yield
+
+
+def make_mesh_context(cfg: Dict[str, Any]) -> MeshContext:
+    """Build the MeshContext from the ``mesh`` config group (analogue of the reference's
+    ``fabric`` group, ``configs/fabric/default.yaml``)."""
+    mesh_cfg = cfg.get("mesh", {}) or {}
+    n_devices = mesh_cfg.get("devices")
+    devices = jax.devices()
+    if n_devices not in (None, -1, "auto"):
+        devices = devices[: int(n_devices)]
+    mesh = build_mesh(
+        data=mesh_cfg.get("data", -1),
+        model=mesh_cfg.get("model", 1),
+        sequence=mesh_cfg.get("sequence", 1),
+        devices=devices,
+    )
+    return MeshContext(mesh=mesh, precision=mesh_cfg.get("precision", "bf16-mixed"), seed=cfg.get("seed", 42))

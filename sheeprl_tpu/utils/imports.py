@@ -1,0 +1,55 @@
+"""Optional-dependency guards + dotted-path instantiation.
+
+``instantiate`` replaces ``hydra.utils.instantiate`` (used by the reference at
+``sheeprl/utils/env.py:73`` to build env adapters from ``_target_`` config nodes).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+from typing import Any, Dict
+
+
+def _available(module: str) -> bool:
+    try:
+        return importlib.util.find_spec(module) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_IS_MLFLOW_AVAILABLE = _available("mlflow")
+_IS_DMC_AVAILABLE = _available("dm_control")
+_IS_CRAFTER_AVAILABLE = _available("crafter")
+_IS_DIAMBRA_AVAILABLE = _available("diambra")
+_IS_MINEDOJO_AVAILABLE = _available("minedojo")
+_IS_MINERL_AVAILABLE = _available("minerl")
+_IS_SMB_AVAILABLE = _available("gym_super_mario_bros")
+_IS_ATARI_AVAILABLE = _available("ale_py")
+_IS_MUJOCO_AVAILABLE = _available("mujoco")
+_IS_BOX2D_AVAILABLE = _available("Box2D") or _available("box2d")
+
+
+def resolve(path: str) -> Any:
+    module_name, _, attr = path.rpartition(".")
+    if not module_name:
+        raise ImportError(f"Cannot resolve '{path}': no module component")
+    module = importlib.import_module(module_name)
+    return getattr(module, attr)
+
+
+def instantiate(node: Dict[str, Any], **overrides: Any) -> Any:
+    """Instantiate ``{_target_: 'pkg.mod.Class', **kwargs}`` config nodes."""
+    if not isinstance(node, dict) or "_target_" not in node:
+        raise ValueError(f"instantiate() requires a dict with a '_target_' key, got: {node!r}")
+    node = dict(node)
+    target = node.pop("_target_")
+    node.pop("_convert_", None)
+    partial = node.pop("_partial_", False)
+    kwargs = {**node, **overrides}
+    cls = resolve(target)
+    if partial:
+        import functools
+
+        return functools.partial(cls, **kwargs)
+    return cls(**kwargs)

@@ -1,0 +1,85 @@
+"""Logging: versioned run directories + TensorBoard writer.
+
+Reference behavior (``sheeprl/utils/logger.py:12-89``): rank-0 creates a versioned log
+dir ``logs/runs/<algo>/<env>/<timestamp>/version_N`` and broadcasts it to all ranks.  In
+single-controller JAX there is one python process per host; the dir is created by
+process 0 and shared via ``multihost_utils`` when running multi-host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def get_log_dir(cfg: Dict[str, Any], root_dir: Optional[str] = None, run_name: Optional[str] = None) -> str:
+    root_dir = root_dir if root_dir is not None else cfg["root_dir"]
+    run_name = run_name if run_name is not None else cfg["run_name"]
+    base = pathlib.Path(cfg.get("log_root", "logs")) / "runs" / root_dir / run_name
+    if jax.process_index() == 0:
+        base.mkdir(parents=True, exist_ok=True)
+        versions = [int(p.name.split("_")[1]) for p in base.glob("version_*") if p.name.split("_")[-1].isdigit()]
+        version = max(versions) + 1 if versions else 0
+        log_dir = base / f"version_{version}"
+        log_dir.mkdir(parents=True, exist_ok=True)
+        path = str(log_dir)
+    else:
+        path = ""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        path = multihost_utils.broadcast_one_to_all(
+            np.frombuffer(path.ljust(512).encode(), dtype=np.uint8)
+        )
+        path = bytes(np.asarray(path)).decode().rstrip()
+    return path
+
+
+class TensorBoardLogger:
+    """Minimal TB scalar writer; uses tensorboard's SummaryWriter when available and
+    falls back to JSONL so logging never becomes a hard dependency."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._writer = None
+        if jax.process_index() != 0:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._writer = SummaryWriter(log_dir=log_dir)
+        except Exception:
+            self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+
+    def log_metrics(self, metrics: Dict[str, float], step: int) -> None:
+        if jax.process_index() != 0:
+            return
+        if self._writer is not None:
+            for k, v in metrics.items():
+                self._writer.add_scalar(k, float(v), global_step=step)
+        else:
+            self._jsonl.write(json.dumps({"step": step, "time": time.time(), **metrics}) + "\n")
+            self._jsonl.flush()
+
+    def log_hyperparams(self, cfg: Dict[str, Any]) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.add_text("config", "```yaml\n" + json.dumps(cfg, default=str, indent=2) + "\n```")
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+def get_logger(cfg: Dict[str, Any], log_dir: str) -> Optional[TensorBoardLogger]:
+    if cfg.get("metric", {}).get("log_level", 1) == 0:
+        return None
+    return TensorBoardLogger(log_dir)

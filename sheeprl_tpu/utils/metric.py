@@ -1,0 +1,131 @@
+"""Metric aggregation (reference: ``sheeprl/utils/metric.py:17-195``).
+
+TPU-native re-design: no torchmetrics.  Metrics are plain host-side accumulators fed with
+python floats or jax scalars; ``compute()`` returns means and drops NaNs the way the
+reference does (``metric.py:109-143``).  Cross-process reduction happens explicitly via
+``jax.experimental.multihost_utils`` in the caller when needed — metrics themselves stay
+host-local so logging never blocks the device.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, Optional
+
+import numpy as np
+
+
+class MeanMetric:
+    def __init__(self):
+        self._sum = 0.0
+        self._count = 0
+
+    def update(self, value: Any) -> None:
+        arr = np.asarray(value, dtype=np.float64)
+        self._sum += float(arr.sum())
+        self._count += int(arr.size)
+
+    def compute(self) -> float:
+        if self._count == 0:
+            return float("nan")
+        return self._sum / self._count
+
+    def reset(self) -> None:
+        self._sum = 0.0
+        self._count = 0
+
+
+class SumMetric(MeanMetric):
+    def compute(self) -> float:
+        return self._sum
+
+
+class LastMetric(MeanMetric):
+    def __init__(self):
+        super().__init__()
+        self._last = float("nan")
+
+    def update(self, value: Any) -> None:
+        self._last = float(np.asarray(value).reshape(-1)[-1])
+        self._count += 1
+
+    def compute(self) -> float:
+        return self._last
+
+
+_METRIC_TYPES = {"mean": MeanMetric, "sum": SumMetric, "last": LastMetric}
+
+
+class MetricAggregator:
+    """Named metric collection with a global disable switch.
+
+    Reference semantics: ``MetricAggregator`` (``metric.py:17-143``) — a dict of named
+    metrics; ``compute()`` returns a flat dict, skipping NaN/empty metrics.
+    """
+
+    disabled: bool = False
+
+    def __init__(self, metrics: Optional[Dict[str, Any]] = None):
+        self.metrics: Dict[str, Any] = {}
+        for name, spec in (metrics or {}).items():
+            self.add(name, spec)
+
+    def add(self, name: str, metric: Any = "mean") -> None:
+        if isinstance(metric, str):
+            metric = _METRIC_TYPES[metric]()
+        elif isinstance(metric, dict):
+            metric = _METRIC_TYPES[metric.get("type", "mean")]()
+        self.metrics[name] = metric
+
+    def update(self, name: str, value: Any) -> None:
+        if MetricAggregator.disabled:
+            return
+        if name not in self.metrics:
+            self.add(name)
+        v = value
+        if hasattr(v, "item") and getattr(v, "size", 1) == 1:
+            v = v.item()
+        self.metrics[name].update(v)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.metrics
+
+    def keep(self, keys: Iterable[str]) -> None:
+        """Prune to a whitelist (reference: AGGREGATOR_KEYS pruning, cli.py:151-165)."""
+        keys = set(keys)
+        self.metrics = {k: v for k, v in self.metrics.items() if k in keys}
+
+    def compute(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        if MetricAggregator.disabled:
+            return out
+        for name, metric in self.metrics.items():
+            v = metric.compute()
+            if v is None or (isinstance(v, float) and math.isnan(v)):
+                continue
+            out[name] = v
+        return out
+
+    def reset(self) -> None:
+        for m in self.metrics.values():
+            m.reset()
+
+
+def record_episode_stats(aggregator: MetricAggregator, info: Dict[str, Any]) -> None:
+    """Feed ``RecordEpisodeStatistics`` vector-env info into the aggregator.
+
+    Handles both gymnasium layouts: ``info["final_info"]["episode"]`` (SAME_STEP
+    autoreset) and a top-level ``info["episode"]``.
+    """
+    src = None
+    if "final_info" in info and isinstance(info["final_info"], dict) and "episode" in info["final_info"]:
+        src = info["final_info"]
+    elif "episode" in info:
+        src = info
+    if src is None:
+        return
+    ep = src["episode"]
+    mask = np.asarray(src.get("_episode", np.ones(np.asarray(ep["r"]).shape, dtype=bool)))
+    for r, l in zip(np.asarray(ep["r"])[mask], np.asarray(ep["l"])[mask]):
+        aggregator.update("Rewards/rew_avg", float(r))
+        aggregator.update("Game/ep_len_avg", float(l))

@@ -1,0 +1,41 @@
+"""Named wall-clock timers (reference: ``sheeprl/utils/timer.py:16-83``).
+
+Class-level registry of named accumulating timers usable as context managers; drives the
+``Time/sps_train`` / ``Time/sps_env_interaction`` throughput metrics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+
+class timer:
+    disabled: bool = False
+    _registry: Dict[str, float] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start = 0.0
+
+    def __enter__(self):
+        if not timer.disabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if not timer.disabled:
+            elapsed = time.perf_counter() - self._start
+            timer._registry[self.name] = timer._registry.get(self.name, 0.0) + elapsed
+        return False
+
+    @classmethod
+    def to_dict(cls, reset: bool = True) -> Dict[str, float]:
+        out = dict(cls._registry)
+        if reset:
+            cls._registry.clear()
+        return out
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._registry.clear()

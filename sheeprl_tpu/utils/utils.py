@@ -1,0 +1,218 @@
+"""Core math / misc utilities, JAX-native.
+
+Re-designs of the reference helpers in ``/root/reference/sheeprl/utils/utils.py``:
+
+* ``gae`` (reference ``:63-100``) — generalized advantage estimation as a reverse
+  ``lax.scan`` instead of a python loop, so it fuses into the jitted update.
+* ``symlog``/``symexp`` (``:148-153``), ``two_hot_encoder/decoder`` (``:156-205``) —
+  pure ``jnp`` functions, vectorized (no scatter loop; a distance kernel over the
+  support works better on the VPU).
+* ``polynomial_decay`` (``:133``), ``normalize_tensor`` (``:120``) — direct equivalents.
+* ``Ratio`` (``:259-300``) — host-side replay-ratio governor, Hafner semantics with
+  identical state-dict fields so resume bookkeeping matches.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import warnings
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.config.core import DotDict as dotdict  # noqa: F401  (re-export)
+
+
+def seed_everything(seed: int) -> jax.Array:
+    """Seed python/numpy RNGs and return a JAX PRNG key."""
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    os.environ.setdefault("PYTHONHASHSEED", str(seed))
+    return jax.random.PRNGKey(seed)
+
+
+# ---------------------------------------------------------------------------
+# Returns / advantages
+# ---------------------------------------------------------------------------
+
+
+def gae(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    num_steps: int,
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """GAE over a ``[T, n_envs, 1]`` rollout (reference: utils/utils.py:63-100).
+
+    ``dones[t]`` marks that the episode ended *at* step t (so the bootstrap for step t is
+    masked).  Returns ``(returns, advantages)`` with the same shape as ``rewards``.
+    """
+    not_done = 1.0 - dones.astype(values.dtype)
+    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
+
+    def step(adv, t):
+        r, v, nv, nd = t
+        delta = r + gamma * nv * nd - v
+        adv = delta + gamma * gae_lambda * nd * adv
+        return adv, adv
+
+    _, advs = jax.lax.scan(
+        step,
+        jnp.zeros_like(next_value),
+        (rewards, values, next_values, not_done),
+        length=num_steps,
+        reverse=True,
+    )
+    returns = advs + values
+    return returns, advs
+
+
+def lambda_returns(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(λ) returns for Dreamer-style imagination (reference: dreamer_v3/utils.py:66-77).
+
+    All inputs ``[T, B, 1]``; ``continues`` already includes the γ factor.  Output is the
+    λ-return for steps ``0..T-2`` (length T-1), bootstrapped from ``values[-1]``.
+    """
+    interm = rewards + continues * values * (1 - lmbda)
+
+    def step(carry, t):
+        inp, disc = t
+        carry = inp + disc * lmbda * carry
+        return carry, carry
+
+    _, rets = jax.lax.scan(
+        step,
+        values[-1],
+        (interm[:-1], continues[:-1]),
+        reverse=True,
+    )
+    return rets
+
+
+# ---------------------------------------------------------------------------
+# Symlog / two-hot
+# ---------------------------------------------------------------------------
+
+
+def symlog(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1)
+
+
+def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optional[int] = None) -> jax.Array:
+    """Two-hot encode scalars ``[..., 1] -> [..., num_buckets]``.
+
+    Matches reference ``utils/utils.py:156-194``: linear support in
+    ``[-support_range, support_range]``, odd bucket count, weights proportional to the
+    distance to the two neighbouring bins.
+    """
+    if num_buckets is None:
+        num_buckets = support_range * 2 + 1
+    if num_buckets % 2 == 0:
+        raise ValueError("num_buckets must be odd")
+    x = jnp.clip(x, -support_range, support_range)
+    buckets = jnp.linspace(-support_range, support_range, num_buckets, dtype=x.dtype)
+    bucket_size = (2.0 * support_range) / (num_buckets - 1) if num_buckets > 1 else 1.0
+    # right index: first bucket >= x (searchsorted semantics of torch.bucketize)
+    right = jnp.searchsorted(buckets, x, side="left").clip(0, num_buckets - 1)
+    left = jnp.clip(right - 1, 0, num_buckets - 1)
+    left_w = jnp.abs(buckets[right] - x) / bucket_size
+    right_w = 1.0 - left_w
+    oh_left = jax.nn.one_hot(left[..., 0], num_buckets, dtype=x.dtype) * left_w
+    oh_right = jax.nn.one_hot(right[..., 0], num_buckets, dtype=x.dtype) * right_w
+    return oh_left + oh_right
+
+
+def two_hot_decoder(t: jax.Array, support_range: int) -> jax.Array:
+    num_buckets = t.shape[-1]
+    if num_buckets % 2 == 0:
+        raise ValueError("support size must be odd")
+    support = jnp.linspace(-support_range, support_range, num_buckets, dtype=t.dtype)
+    return jnp.sum(t * support, axis=-1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# Misc
+# ---------------------------------------------------------------------------
+
+
+def polynomial_decay(
+    current_step: int,
+    *,
+    initial: float = 1.0,
+    final: float = 0.0,
+    max_decay_steps: int = 100,
+    power: float = 1.0,
+) -> float:
+    if current_step > max_decay_steps or initial == final:
+        return final
+    return (initial - final) * ((1 - current_step / max_decay_steps) ** power) + final
+
+
+def normalize_tensor(x: jax.Array, eps: float = 1e-8, mask: Optional[jax.Array] = None) -> jax.Array:
+    if mask is None:
+        return (x - x.mean()) / (x.std() + eps)
+    m = mask.astype(x.dtype)
+    n = m.sum()
+    mean = (x * m).sum() / n
+    var = (((x - mean) ** 2) * m).sum() / jnp.maximum(n - 1, 1)
+    return (x - mean) / (jnp.sqrt(var) + eps)
+
+
+class Ratio:
+    """Replay-ratio governor (Hafner); reference ``utils/utils.py:259-300``.
+
+    Called with the cumulative policy-step count; returns how many gradient steps to run
+    this iteration so the long-run ratio converges to ``ratio``.
+    """
+
+    def __init__(self, ratio: float, pretrain_steps: int = 0):
+        if pretrain_steps < 0:
+            raise ValueError(f"'pretrain_steps' must be non-negative, got {pretrain_steps}")
+        if ratio < 0:
+            raise ValueError(f"'ratio' must be non-negative, got {ratio}")
+        self._pretrain_steps = pretrain_steps
+        self._ratio = ratio
+        self._prev: Optional[float] = None
+
+    def __call__(self, step: int) -> int:
+        if self._ratio == 0:
+            return 0
+        if self._prev is None:
+            self._prev = step
+            repeats = int(step * self._ratio)
+            if self._pretrain_steps > 0:
+                if step < self._pretrain_steps:
+                    warnings.warn(
+                        "pretrain_steps > current steps; clamping pretrain_steps to the "
+                        "current step count to keep the requested replay ratio."
+                    )
+                    self._pretrain_steps = step
+                repeats = int(self._pretrain_steps * self._ratio)
+            return repeats
+        repeats = int((step - self._prev) * self._ratio)
+        self._prev += repeats / self._ratio
+        return repeats
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"_ratio": self._ratio, "_prev": self._prev, "_pretrain_steps": self._pretrain_steps}
+
+    def load_state_dict(self, state: Mapping[str, Any]) -> "Ratio":
+        self._ratio = state["_ratio"]
+        self._prev = state["_prev"]
+        self._pretrain_steps = state["_pretrain_steps"]
+        return self

@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initialises.
+
+Mirrors the reference's multi-device CI trick (LT_DEVICES with gloo on localhost,
+``tests/test_algos/test_algos.py:16-18``) using
+``--xla_force_host_platform_device_count`` per SURVEY §4's TPU-build implication.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("SHEEPRL_TPU_QUIET", "1")
+
+# The image's sitecustomize registers the TPU plugin and sets jax_platforms at
+# interpreter start (before this file runs); backends initialise lazily, so
+# overriding the config here still lands before any device is created.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_logs(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path

@@ -1,0 +1,74 @@
+"""End-to-end dry-run smoke tests through the real CLI, per algorithm × dummy env
+(the reference's dominant test pattern, ``tests/test_algos/test_algos.py:21-566``)."""
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def standard_args(tmp_path, extra=()):
+    return [
+        "dry_run=True",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "checkpoint.every=1",
+        "checkpoint.save_last=True",
+        "metric.log_every=1",
+        f"log_root={tmp_path}",
+        "buffer.memmap=False",
+        *extra,
+    ]
+
+
+PPO_ARGS = [
+    "exp=ppo",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=8",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.encoder.cnn_features_dim=16",
+    "algo.encoder.mlp_features_dim=8",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_ppo_dummy_envs(tmp_path, env_id):
+    run(
+        PPO_ARGS
+        + [f"env={env_id}", "algo.mlp_keys.encoder=[state]", "algo.cnn_keys.encoder=[rgb]"]
+        + standard_args(tmp_path)
+    )
+
+
+def test_ppo_vector_obs_only(tmp_path):
+    run(PPO_ARGS + ["env=discrete_dummy", "algo.mlp_keys.encoder=[state]"] + standard_args(tmp_path))
+
+
+def test_ppo_resume_from_checkpoint(tmp_path):
+    run(
+        PPO_ARGS
+        + ["env=discrete_dummy", "algo.mlp_keys.encoder=[state]", "algo.total_steps=32"]
+        + standard_args(tmp_path)
+    )
+    ckpts = sorted((tmp_path).rglob("ckpt_*"))
+    assert ckpts, "no checkpoint written"
+    run(
+        PPO_ARGS
+        + [
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            f"checkpoint.resume_from={ckpts[-1]}",
+        ]
+        + standard_args(tmp_path)
+    )
+
+
+def test_ppo_evaluate_roundtrip(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(PPO_ARGS + ["env=discrete_dummy", "algo.mlp_keys.encoder=[state]"] + standard_args(tmp_path))
+    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    assert ckpts
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])

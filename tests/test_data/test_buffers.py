@@ -1,0 +1,149 @@
+"""ReplayBuffer semantics (modeled on the reference suite ``tests/test_data/test_buffers.py``)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, ReplayBuffer, SequentialReplayBuffer
+
+
+def _data(t, n_envs, pos0=0):
+    return {
+        "observations": np.arange(pos0, pos0 + t, dtype=np.float32).reshape(t, 1, 1).repeat(n_envs, 1),
+        "dones": np.zeros((t, n_envs, 1), dtype=np.float32),
+    }
+
+
+def test_replay_buffer_add_and_len():
+    rb = ReplayBuffer(8, n_envs=2)
+    rb.add(_data(3, 2))
+    assert len(rb) == 3
+    assert not rb.full
+    rb.add(_data(5, 2, 3))
+    assert len(rb) == 8
+    assert rb.full
+
+
+def test_replay_buffer_wraparound():
+    rb = ReplayBuffer(4, n_envs=1)
+    rb.add(_data(3, 1))
+    rb.add(_data(3, 1, 3))
+    assert rb.full
+    # Positions 0,1 hold steps 4,5 (wrapped); 2,3 hold 2,3.
+    assert rb["observations"][0, 0, 0] == 4.0
+    assert rb["observations"][1, 0, 0] == 5.0
+    assert rb["observations"][2, 0, 0] == 2.0
+
+
+def test_replay_buffer_oversized_add():
+    rb = ReplayBuffer(4, n_envs=1)
+    rb.add(_data(10, 1))
+    assert rb.full
+    # Only the trailing window survives.
+    assert sorted(rb["observations"][:, 0, 0].tolist()) == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_replay_buffer_sample_shapes():
+    rb = ReplayBuffer(16, n_envs=2)
+    rb.add(_data(10, 2))
+    s = rb.sample(6, n_samples=3)
+    assert s["observations"].shape == (3, 6, 1)
+
+
+def test_replay_buffer_sample_next_obs_pairs():
+    rb = ReplayBuffer(8, n_envs=1)
+    rb.add(_data(8, 1))
+    s = rb.sample(64, sample_next_obs=True)
+    obs, nxt = s["observations"][0, :, 0], s["next_observations"][0, :, 0]
+    assert np.allclose(nxt, obs + 1)
+
+
+def test_replay_buffer_sample_next_obs_full_no_cursor_crossing():
+    rb = ReplayBuffer(6, n_envs=1)
+    rb.add(_data(9, 1))  # full, pos=3; entries 3..8 with oldest (3) at index 3
+    s = rb.sample(256, sample_next_obs=True)
+    obs, nxt = s["observations"][0, :, 0], s["next_observations"][0, :, 0]
+    assert np.allclose(nxt, obs + 1)  # never pairs newest with oldest
+
+
+def test_replay_buffer_sample_errors():
+    rb = ReplayBuffer(4)
+    with pytest.raises(ValueError):
+        rb.sample(1)
+    rb.add(_data(2, 1))
+    with pytest.raises(ValueError):
+        rb.sample(0)
+
+
+def test_replay_buffer_getitem_setitem():
+    rb = ReplayBuffer(4, n_envs=2)
+    rb["rewards"] = np.ones((4, 2, 1), dtype=np.float32)
+    assert rb["rewards"].sum() == 8
+    with pytest.raises(RuntimeError):
+        rb["bad"] = np.ones((3, 2, 1))
+
+
+def test_replay_buffer_memmap(tmp_path):
+    rb = ReplayBuffer(8, n_envs=1, memmap=True, memmap_dir=tmp_path / "mm")
+    rb.add(_data(4, 1))
+    assert rb.is_memmap
+    assert (tmp_path / "mm" / "observations.memmap").exists()
+    assert len(rb) == 4
+
+
+def test_replay_buffer_state_dict_roundtrip():
+    rb = ReplayBuffer(8, n_envs=1)
+    rb.add(_data(5, 1))
+    state = rb.state_dict()
+    rb2 = ReplayBuffer(8, n_envs=1)
+    rb2.load_state_dict(state)
+    assert len(rb2) == 5
+    assert np.allclose(rb2["observations"], rb["observations"])
+
+
+# -- SequentialReplayBuffer -------------------------------------------------
+
+
+def test_sequential_sample_contiguous():
+    rb = SequentialReplayBuffer(32, n_envs=1)
+    rb.add(_data(20, 1))
+    s = rb.sample(4, sequence_length=5, n_samples=2)
+    assert s["observations"].shape == (2, 5, 4, 1)
+    seq = s["observations"][0, :, 0, 0]
+    assert np.allclose(np.diff(seq), 1)
+
+
+def test_sequential_sample_full_wraparound_valid():
+    rb = SequentialReplayBuffer(8, n_envs=1)
+    rb.add(_data(12, 1))  # full, pos=4, valid chronological window 4..11
+    s = rb.sample(64, sequence_length=3)
+    seqs = s["observations"][0]  # [T, B, 1]
+    diffs = np.diff(seqs[:, :, 0], axis=0)
+    assert np.allclose(diffs, 1)  # every sequence strictly consecutive
+
+
+def test_sequential_sample_too_long_raises():
+    rb = SequentialReplayBuffer(8, n_envs=1)
+    rb.add(_data(4, 1))
+    with pytest.raises(ValueError):
+        rb.sample(1, sequence_length=6)
+
+
+# -- EnvIndependentReplayBuffer ---------------------------------------------
+
+
+def test_env_independent_add_indices_and_sample():
+    rb = EnvIndependentReplayBuffer(16, n_envs=3)
+    data = _data(4, 2)
+    rb.add(data, indices=[0, 2])
+    assert len(rb.buffer[0]) == 4
+    assert len(rb.buffer[1]) == 0
+    assert len(rb.buffer[2]) == 4
+    s = rb.sample(8)
+    assert s["observations"].shape[:2] == (1, 8)
+
+
+def test_env_independent_sequential():
+    rb = EnvIndependentReplayBuffer(32, n_envs=2, buffer_cls=SequentialReplayBuffer)
+    rb.add(_data(16, 2))
+    s = rb.sample(4, sequence_length=4)
+    assert s["observations"].shape == (1, 4, 4, 1)

@@ -1,0 +1,78 @@
+"""EpisodeBuffer semantics (reference: ``tests/test_data/test_episode_buffer.py``)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EpisodeBuffer
+
+
+def _episode_data(length, n_envs=1, end=True):
+    term = np.zeros((length, n_envs, 1), dtype=np.float32)
+    if end:
+        term[-1] = 1
+    return {
+        "observations": np.arange(length, dtype=np.float32).reshape(length, 1, 1).repeat(n_envs, 1),
+        "terminated": term,
+        "truncated": np.zeros_like(term),
+    }
+
+
+def test_episode_buffer_add_complete_episode():
+    eb = EpisodeBuffer(64, minimum_episode_length=2)
+    eb.add(_episode_data(10))
+    assert len(eb) == 10
+    assert len(eb.buffer) == 1
+
+
+def test_episode_buffer_open_episode_not_stored():
+    eb = EpisodeBuffer(64, minimum_episode_length=2)
+    eb.add(_episode_data(5, end=False))
+    assert len(eb) == 0
+    eb.add(_episode_data(3))
+    assert len(eb) == 8  # chunks concatenated into one episode
+
+
+def test_episode_buffer_too_short_raises():
+    eb = EpisodeBuffer(64, minimum_episode_length=5)
+    with pytest.raises(RuntimeError):
+        eb.add(_episode_data(3))
+
+
+def test_episode_buffer_eviction():
+    eb = EpisodeBuffer(20, minimum_episode_length=2)
+    for _ in range(4):
+        eb.add(_episode_data(8))
+    assert len(eb) <= 20
+    assert len(eb.buffer) == 2
+
+
+def test_episode_buffer_sample_shapes():
+    eb = EpisodeBuffer(64, minimum_episode_length=2)
+    eb.add(_episode_data(20))
+    s = eb.sample(3, sequence_length=6, n_samples=2)
+    assert s["observations"].shape == (2, 6, 3, 1)
+    seq = s["observations"][0, :, 0, 0]
+    assert np.allclose(np.diff(seq), 1)
+
+
+def test_episode_buffer_prioritize_ends():
+    eb = EpisodeBuffer(64, minimum_episode_length=2, prioritize_ends=True)
+    eb.add(_episode_data(10))
+    s = eb.sample(64, sequence_length=4)
+    # With prioritised ends the last step must appear in some sampled sequence.
+    assert (s["observations"] == 9).any()
+
+
+def test_episode_buffer_sample_no_valid_raises():
+    eb = EpisodeBuffer(64, minimum_episode_length=2)
+    eb.add(_episode_data(3))
+    with pytest.raises(RuntimeError):
+        eb.sample(1, sequence_length=10)
+
+
+def test_episode_buffer_memmap(tmp_path):
+    eb = EpisodeBuffer(64, minimum_episode_length=2, memmap=True, memmap_dir=tmp_path / "eb")
+    eb.add(_episode_data(6))
+    assert len(eb) == 6
+    s = eb.sample(2, sequence_length=3)
+    assert s["observations"].shape == (1, 3, 2, 1)

@@ -1,0 +1,120 @@
+"""Env wrapper + make_env pipeline tests (reference: ``tests/test_envs/``)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config.core import compose
+from sheeprl_tpu.envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from sheeprl_tpu.envs.wrappers import ActionRepeat, ActionsAsObservationWrapper, FrameStack, RewardAsObservationWrapper
+from sheeprl_tpu.utils.env import make_env
+
+
+def test_dummy_env_contract():
+    env = DiscreteDummyEnv(n_steps=4)
+    obs, _ = env.reset()
+    assert set(obs.keys()) == {"rgb", "state"}
+    assert obs["rgb"].shape == (3, 64, 64)
+    done = False
+    steps = 0
+    while not done:
+        obs, r, term, trunc, _ = env.step(env.action_space.sample())
+        done = term or trunc
+        steps += 1
+    assert steps == 5
+
+
+def test_action_repeat_accumulates_reward():
+    class RewEnv(DiscreteDummyEnv):
+        def step(self, action):
+            obs, _, d, t, i = super().step(action)
+            return obs, 1.0, d, t, i
+
+    env = ActionRepeat(RewEnv(n_steps=100), 4)
+    env.reset()
+    _, reward, *_ = env.step(0)
+    assert reward == 4.0
+
+
+def test_frame_stack_shapes_and_dilation():
+    env = FrameStack(DiscreteDummyEnv(n_steps=100), num_stack=3, cnn_keys=["rgb"], dilation=2)
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (3, 3, 64, 64)
+    for step in range(5):
+        obs, *_ = env.step(0)
+    # With dilation 2 the stacked frames are 2 steps apart.
+    frames = obs["rgb"][:, 0, 0, 0].astype(int)
+    assert frames[2] - frames[1] == 2
+
+
+def test_actions_as_observation_discrete():
+    env = ActionsAsObservationWrapper(DiscreteDummyEnv(action_dim=3, n_steps=100), num_stack=2, noop=0)
+    obs, _ = env.reset()
+    assert obs["action_stack"].shape == (6,)
+    assert obs["action_stack"][0] == 1.0  # noop one-hot
+    obs, *_ = env.step(2)
+    assert obs["action_stack"][-1] == 1.0  # last action one-hot at idx 2
+
+
+def test_actions_as_observation_continuous_noop_validation():
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(ContinuousDummyEnv(action_dim=2), num_stack=2, noop=0)
+
+
+def test_reward_as_observation():
+    env = RewardAsObservationWrapper(DiscreteDummyEnv(n_steps=100))
+    obs, _ = env.reset()
+    assert "reward" in obs
+    assert obs["reward"].shape == (1,)
+
+
+def _pipeline_cfg(env_option, cnn=("rgb",), mlp=("state",), **env_overrides):
+    overrides = ["exp=ppo_dummy", f"env={env_option}"]
+    overrides.append("algo.cnn_keys.encoder=" + str(list(cnn)).replace("'", '"'))
+    overrides.append("algo.mlp_keys.encoder=" + str(list(mlp)).replace("'", '"'))
+    for k, v in env_overrides.items():
+        overrides.append(f"env.{k}={v}")
+    return compose(overrides=overrides)
+
+
+@pytest.mark.parametrize("env_option", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_make_env_pipeline_dict_obs(env_option):
+    cfg = _pipeline_cfg(env_option)
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (3, 64, 64)
+    assert obs["rgb"].dtype == np.uint8
+    assert obs["state"].shape == (10,)
+    env.close()
+
+
+def test_make_env_grayscale_resize():
+    cfg = _pipeline_cfg("discrete_dummy", grayscale=True, screen_size=32)
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (1, 32, 32)
+    env.close()
+
+
+def test_make_env_frame_stack():
+    cfg = _pipeline_cfg("discrete_dummy", frame_stack=4)
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (4, 3, 64, 64)
+    env.close()
+
+
+def test_make_env_vector_only_gym():
+    cfg = compose(overrides=["exp=ppo", "env.capture_video=False"])
+    cfg.algo.mlp_keys.encoder = ["state"]
+    cfg.algo.cnn_keys.encoder = []
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert list(obs.keys()) == ["state"]
+    assert obs["state"].shape == (4,)
+    env.close()
+
+
+def test_make_env_unknown_keys_raise():
+    cfg = _pipeline_cfg("discrete_dummy", cnn=("nope",), mlp=())
+    with pytest.raises(ValueError):
+        make_env(cfg, seed=0, rank=0)()

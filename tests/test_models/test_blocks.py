@@ -1,0 +1,117 @@
+"""Model-block shape/semantic tests (reference: ``tests/test_models/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models.blocks import (
+    CNN,
+    MLP,
+    DeCNN,
+    LayerNormGRUCell,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+    cnn_obs_to_nhwc,
+)
+
+
+def test_mlp_shapes():
+    m = MLP(hidden_sizes=(32, 32), output_dim=5, activation="tanh", layer_norm=True)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((4, 10)))
+    out = m.apply(params, jnp.ones((4, 10)))
+    assert out.shape == (4, 5)
+
+
+def test_mlp_no_output_head():
+    m = MLP(hidden_sizes=(16,))
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((2, 3)))
+    assert m.apply(params, jnp.ones((2, 3))).shape == (2, 16)
+
+
+def test_cnn_and_decnn_shapes():
+    cnn = CNN(channels=(8, 16), kernels=(3,), strides=(2,), paddings=("SAME",))
+    params = cnn.init(jax.random.PRNGKey(0), jnp.zeros((2, 16, 16, 3)))
+    out = cnn.apply(params, jnp.zeros((2, 16, 16, 3)))
+    assert out.shape == (2, 4, 4, 16)
+
+    dec = DeCNN(channels=(8, 3), kernels=(3,), strides=(2,))
+    dparams = dec.init(jax.random.PRNGKey(0), out)
+    rec = dec.apply(dparams, out)
+    assert rec.shape == (2, 16, 16, 3)
+
+
+def test_nature_cnn_shape():
+    m = NatureCNN(features_dim=128)
+    x = jnp.zeros((3, 64, 64, 4))
+    params = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(params, x).shape == (3, 128)
+
+
+def test_layernorm_gru_cell():
+    cell = LayerNormGRUCell(hidden_size=16)
+    h = jnp.zeros((4, 16))
+    x = jnp.ones((4, 8))
+    params = cell.init(jax.random.PRNGKey(0), h, x)
+    h1, out = cell.apply(params, h, x)
+    assert h1.shape == (4, 16)
+    assert np.allclose(np.asarray(h1), np.asarray(out))
+    # Must be scannable over time.
+    xs = jnp.ones((5, 4, 8))
+    h_final, _ = jax.lax.scan(lambda c, xt: cell.apply(params, c, xt), h, xs)
+    assert h_final.shape == (4, 16)
+
+
+def test_cnn_obs_to_nhwc_plain_and_stacked():
+    x = jnp.zeros((2, 3, 8, 8), dtype=jnp.uint8)
+    out = cnn_obs_to_nhwc(x)
+    assert out.shape == (2, 8, 8, 3)
+    assert out.dtype == jnp.float32
+    stacked = jnp.zeros((2, 4, 3, 8, 8), dtype=jnp.uint8)
+    out = cnn_obs_to_nhwc(stacked, stacked=True)
+    assert out.shape == (2, 8, 8, 12)
+    # A 5-D sequence batch without the flag keeps time/batch separate.
+    seq = jnp.zeros((5, 2, 3, 8, 8), dtype=jnp.uint8)
+    out = cnn_obs_to_nhwc(seq)
+    assert out.shape == (5, 2, 8, 8, 3)
+
+
+@pytest.mark.parametrize("lead", [(2,), (3, 2)])
+def test_multi_encoder_shapes(lead):
+    enc = MultiEncoder(
+        cnn_keys=["rgb"],
+        mlp_keys=["state"],
+        cnn_channels=(8, 16),
+        cnn_kernels=(4, 4),
+        cnn_strides=(2, 2),
+        cnn_features_dim=32,
+        mlp_hidden_sizes=(16,),
+        mlp_features_dim=8,
+    )
+    obs = {
+        "rgb": jnp.zeros((*lead, 3, 16, 16), dtype=jnp.uint8),
+        "state": jnp.zeros((*lead, 10)),
+    }
+    params = enc.init(jax.random.PRNGKey(0), obs)
+    out = enc.apply(params, obs)
+    assert out.shape == (*lead, 40)
+
+
+def test_multi_decoder_shapes():
+    dec = MultiDecoder(
+        cnn_keys=["rgb"],
+        mlp_keys=["state"],
+        cnn_shapes={"rgb": (3, 32, 32)},
+        mlp_shapes={"state": (10,)},
+        cnn_decoder_init=(4, 4, 32),
+        cnn_channels=(16, 8, 3),
+        cnn_kernels=(4, 4, 4),
+        cnn_strides=(2, 2, 2),
+        mlp_hidden_sizes=(16,),
+    )
+    z = jnp.zeros((5, 64))
+    params = dec.init(jax.random.PRNGKey(0), z)
+    out = dec.apply(params, z)
+    assert out["rgb"].shape == (5, 3, 32, 32)
+    assert out["state"].shape == (5, 10)

@@ -1,0 +1,59 @@
+"""Config composition engine tests."""
+
+import pytest
+
+from sheeprl_tpu.config.core import DotDict, compose
+
+
+def test_compose_exp_preset():
+    cfg = compose(overrides=["exp=ppo_dummy"])
+    assert cfg.algo.name == "ppo"
+    assert cfg.env.id == "discrete_dummy"
+    assert cfg.algo.mlp_keys.encoder == ["state"]
+
+
+def test_group_and_value_overrides():
+    cfg = compose(overrides=["exp=ppo", "env=dummy", "algo.rollout_steps=7", "seed=9"])
+    assert cfg.env.id == "discrete_dummy"
+    assert cfg.algo.rollout_steps == 7
+    assert cfg.seed == 9
+
+
+def test_interpolation_resolution():
+    cfg = compose(overrides=["exp=ppo_dummy"])
+    assert cfg.exp_name == "ppo_discrete_dummy"
+    assert cfg.buffer.size == cfg.algo.rollout_steps
+    assert cfg.algo.encoder.dense_act == cfg.algo.dense_act
+
+
+def test_scientific_notation_parses_as_float():
+    cfg = compose(overrides=["exp=ppo_dummy", "algo.optimizer.lr=3e-4"])
+    assert isinstance(cfg.algo.optimizer.lr, float)
+    assert cfg.algo.optimizer.lr == pytest.approx(3e-4)
+
+
+def test_missing_mandatory_group_raises():
+    with pytest.raises(ValueError, match="Mandatory"):
+        compose(overrides=[])
+
+
+def test_unknown_group_option_raises():
+    with pytest.raises(FileNotFoundError, match="Available"):
+        compose(overrides=["exp=ppo_dummy", "env=does_not_exist"])
+
+
+def test_search_path_extension(tmp_path, monkeypatch):
+    exp_dir = tmp_path / "exp"
+    exp_dir.mkdir()
+    (exp_dir / "custom.yaml").write_text("defaults:\n  - ppo_dummy\nseed: 123\n")
+    monkeypatch.setenv("SHEEPRL_TPU_SEARCH_PATH", str(tmp_path))
+    cfg = compose(overrides=["exp=custom"])
+    assert cfg.seed == 123
+    assert cfg.algo.name == "ppo"
+
+
+def test_dotdict_attribute_access():
+    d = DotDict.wrap({"a": {"b": 1}})
+    assert d.a.b == 1
+    d.a.c = 2
+    assert d["a"]["c"] == 2

@@ -1,0 +1,103 @@
+"""Distribution semantics: log-probs, straight-through gradients, two-hot."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.distributions import (
+    BernoulliSafeMode,
+    Categorical,
+    Independent,
+    MultiCategorical,
+    Normal,
+    OneHotCategoricalStraightThrough,
+    SymlogDistribution,
+    TanhNormal,
+    TwoHotEncodingDistribution,
+    unimix_logits,
+)
+
+
+def test_normal_log_prob_matches_scipy():
+    from scipy.stats import norm
+
+    d = Normal(jnp.array(1.0), jnp.array(2.0))
+    assert np.isclose(float(d.log_prob(jnp.array(0.5))), norm.logpdf(0.5, 1.0, 2.0), atol=1e-5)
+    assert np.isclose(float(d.entropy()), norm.entropy(1.0, 2.0), atol=1e-5)
+
+
+def test_independent_reduces_event_dims():
+    d = Independent(Normal(jnp.zeros((4, 3)), jnp.ones((4, 3))), 1)
+    lp = d.log_prob(jnp.zeros((4, 3)))
+    assert lp.shape == (4,)
+
+
+def test_tanh_normal_log_prob_consistency():
+    d = TanhNormal(jnp.zeros(3), jnp.ones(3))
+    act, logp = d.sample_and_log_prob(jax.random.PRNGKey(0))
+    assert np.all(np.abs(np.asarray(act)) <= 1.0)
+    logp2 = d.log_prob(act)
+    assert np.allclose(np.asarray(logp), np.asarray(logp2), atol=1e-4)
+
+
+def test_categorical_log_prob():
+    logits = jnp.log(jnp.array([[0.2, 0.8]]))
+    d = Categorical(logits)
+    assert np.isclose(float(d.log_prob(jnp.array([1]))[0]), np.log(0.8), atol=1e-5)
+    assert int(d.mode[0]) == 1
+
+
+def test_onehot_straight_through_gradient_flows():
+    def f(logits, key):
+        d = OneHotCategoricalStraightThrough(logits)
+        return (d.rsample(key) * jnp.arange(4.0)).sum()
+
+    g = jax.grad(f)(jnp.zeros(4), jax.random.PRNGKey(0))
+    assert np.any(np.asarray(g) != 0)  # gradient flows through probs
+
+
+def test_unimix_mixes_uniform():
+    logits = jnp.array([100.0, 0.0, 0.0, 0.0])
+    mixed = unimix_logits(logits, unimix=0.01)
+    probs = np.asarray(jax.nn.softmax(mixed))
+    assert probs.min() > 0.002  # uniform floor present
+
+
+def test_two_hot_distribution_mean_inverts_symlog():
+    bins = 255
+    target = 7.3
+    from sheeprl_tpu.utils.utils import symlog, two_hot_encoder
+
+    enc = two_hot_encoder(symlog(jnp.array([target])), support_range=20, num_buckets=bins)
+    # logits == log target distribution → mean must decode back
+    d = TwoHotEncodingDistribution(jnp.log(enc + 1e-8))
+    assert np.isclose(float(d.mean[0]), target, atol=0.05)
+
+
+def test_two_hot_log_prob_maximised_at_target():
+    logits = jnp.zeros((1, 255))
+    d = TwoHotEncodingDistribution(logits)
+    lp = d.log_prob(jnp.array([[3.0]]))
+    assert lp.shape == (1, 1)
+
+
+def test_symlog_distribution_mode():
+    d = SymlogDistribution(jnp.array([[0.0, 1.0]]), dims=1)
+    assert np.allclose(np.asarray(d.mode), np.asarray([[0.0, np.e - 1]]), atol=1e-5)
+    lp = d.log_prob(jnp.array([[0.0, np.e - 1]]))
+    assert np.isclose(float(lp[0]), 0.0, atol=1e-5)
+
+
+def test_bernoulli_safe_mode():
+    d = BernoulliSafeMode(jnp.zeros(3))
+    assert np.allclose(np.asarray(d.mode), 0)
+    d = BernoulliSafeMode(jnp.ones(3))
+    assert np.allclose(np.asarray(d.mode), 1)
+
+
+def test_multi_categorical():
+    logits = jnp.log(jnp.array([0.1, 0.9, 0.5, 0.5]))[None]
+    d = MultiCategorical(logits, nvec=[2, 2])
+    lp = d.log_prob(jnp.array([[1, 0]]))
+    assert np.isclose(float(lp[0]), np.log(0.9) + np.log(0.5), atol=1e-5)
+    assert d.mode.shape == (1, 2)

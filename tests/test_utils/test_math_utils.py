@@ -1,0 +1,111 @@
+"""symlog/two-hot/GAE/Ratio semantics (reference: ``tests/test_utils/test_two_hot_*.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.utils import (
+    Ratio,
+    gae,
+    lambda_returns,
+    polynomial_decay,
+    symexp,
+    symlog,
+    two_hot_decoder,
+    two_hot_encoder,
+)
+
+
+def test_symlog_symexp_inverse():
+    x = jnp.array([-100.0, -1.0, 0.0, 0.5, 42.0])
+    assert np.allclose(symexp(symlog(x)), x, atol=1e-4)
+
+
+@pytest.mark.parametrize("value", [-19.7, -1.0, 0.0, 0.3, 7.77, 19.9])
+def test_two_hot_roundtrip(value):
+    enc = two_hot_encoder(jnp.array([value]), support_range=20, num_buckets=41)
+    assert enc.shape == (41,)
+    assert np.isclose(float(enc.sum()), 1.0, atol=1e-5)
+    dec = two_hot_decoder(enc, support_range=20)
+    assert np.isclose(float(dec[0]), value, atol=1e-4)
+
+
+def test_two_hot_exact_bucket():
+    enc = two_hot_encoder(jnp.array([3.0]), support_range=5, num_buckets=11)
+    assert np.isclose(float(enc[8]), 1.0, atol=1e-5)
+    assert np.isclose(float(enc.sum()), 1.0, atol=1e-5)
+
+
+def test_two_hot_clipping():
+    enc = two_hot_encoder(jnp.array([1000.0]), support_range=5, num_buckets=11)
+    assert np.isclose(float(enc[-1]), 1.0, atol=1e-5)
+
+
+def test_two_hot_even_buckets_raises():
+    with pytest.raises(ValueError):
+        two_hot_encoder(jnp.array([0.0]), support_range=5, num_buckets=10)
+
+
+def test_gae_matches_reference_recursion():
+    T, N = 5, 2
+    rng = np.random.default_rng(0)
+    rewards = rng.normal(size=(T, N, 1)).astype(np.float32)
+    values = rng.normal(size=(T, N, 1)).astype(np.float32)
+    dones = np.zeros((T, N, 1), dtype=np.float32)
+    dones[2, 0] = 1
+    next_value = rng.normal(size=(N, 1)).astype(np.float32)
+    gamma, lam = 0.99, 0.95
+
+    # straightforward python recursion
+    adv_ref = np.zeros_like(rewards)
+    last = np.zeros((N, 1), dtype=np.float32)
+    vals_next = np.concatenate([values[1:], next_value[None]], 0)
+    for t in reversed(range(T)):
+        nd = 1.0 - dones[t]
+        delta = rewards[t] + gamma * vals_next[t] * nd - values[t]
+        last = delta + gamma * lam * nd * last
+        adv_ref[t] = last
+
+    returns, advs = jax.jit(lambda r, v, d, nv: gae(r, v, d, nv, T, gamma, lam))(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones), jnp.asarray(next_value)
+    )
+    assert np.allclose(np.asarray(advs), adv_ref, atol=1e-5)
+    assert np.allclose(np.asarray(returns), adv_ref + values, atol=1e-5)
+
+
+def test_lambda_returns_bootstrap():
+    T, B = 4, 3
+    rewards = jnp.ones((T, B, 1))
+    values = jnp.ones((T, B, 1)) * 2.0
+    continues = jnp.ones((T, B, 1)) * 0.9
+    rets = lambda_returns(rewards, values, continues, lmbda=0.95)
+    assert rets.shape == (T - 1, B, 1)
+    # Final step: r + c*(v*(1-l) + l*boot) with boot = values[-1]
+    expected_last = 1 + 0.9 * (2.0 * 0.05 + 0.95 * 2.0)
+    assert np.isclose(float(rets[-1, 0, 0]), expected_last, atol=1e-5)
+
+
+def test_polynomial_decay():
+    assert polynomial_decay(0, initial=1.0, final=0.0, max_decay_steps=10) == 1.0
+    assert polynomial_decay(10, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
+    assert polynomial_decay(50, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
+    assert np.isclose(polynomial_decay(5, initial=1.0, final=0.0, max_decay_steps=10), 0.5)
+
+
+def test_ratio_converges():
+    ratio = Ratio(0.5)
+    total_grad = 0
+    step = 0
+    for _ in range(100):
+        step += 16
+        total_grad += ratio(step)
+    assert abs(total_grad / step - 0.5) < 0.05
+
+
+def test_ratio_state_dict_roundtrip():
+    r = Ratio(0.25)
+    r(100)
+    state = r.state_dict()
+    r2 = Ratio(1.0).load_state_dict(state)
+    assert r2.state_dict() == state

@@ -1,0 +1,42 @@
+"""MemmapArray ownership/pickling (reference: ``tests/test_utils/test_memmap.py``)."""
+
+import pickle
+
+import numpy as np
+
+from sheeprl_tpu.utils.memmap import MemmapArray
+
+
+def test_from_array_roundtrip(tmp_path):
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    mm = MemmapArray.from_array(arr, filename=tmp_path / "a.memmap")
+    assert np.allclose(mm.array, arr)
+    mm[0, 0] = 99
+    assert mm.array[0, 0] == 99
+
+
+def test_pickle_drops_ownership(tmp_path):
+    mm = MemmapArray.from_array(np.ones((2, 2)), filename=tmp_path / "b.memmap")
+    clone = pickle.loads(pickle.dumps(mm))
+    assert not clone.has_ownership
+    assert mm.has_ownership
+    assert np.allclose(clone.array, mm.array)
+    # Writes through the clone are visible to the owner (same backing file).
+    clone[0, 0] = 7
+    assert mm.array[0, 0] == 7
+
+
+def test_owner_deletes_file(tmp_path):
+    path = tmp_path / "c.memmap"
+    mm = MemmapArray.from_array(np.zeros(4), filename=path)
+    assert path.exists()
+    del mm
+    assert not path.exists()
+
+
+def test_from_array_same_file_does_not_steal_ownership(tmp_path):
+    path = tmp_path / "d.memmap"
+    mm = MemmapArray.from_array(np.zeros(4), filename=path)
+    mm2 = MemmapArray.from_array(mm, filename=path)
+    assert mm.has_ownership
+    assert not mm2.has_ownership
