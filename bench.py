@@ -1,0 +1,108 @@
+"""Benchmark: DreamerV3 gradient-steps/sec on the flagship config.
+
+Runs the full jitted DreamerV3 train step (world model + actor + critic + EMA + moments)
+on synthetic Atari-100K-shaped data — batch 16 × sequence 64 × 64×64×3 pixels, model
+size S — matching the reference's headline benchmark config
+(BASELINE.md: DreamerV3-S on Atari MsPacman-100K).
+
+Baseline: the reference reports 14 h on 1× RTX 3080 for Atari-100K
+(README.md:46-53).  100K frames at action-repeat 4 → 25K policy steps; replay ratio 0.5
+→ ~12.5K gradient steps ⇒ ≈0.25 grad-steps/s end-to-end. Train-only throughput is
+higher; we conservatively estimate the reference's pure train-step rate at ~1.0
+grad-steps/s on its GPU (no absolute number is published — BASELINE.md notes the cell
+is empty).  ``vs_baseline`` is measured/1.0.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+os.environ.setdefault("SHEEPRL_TPU_QUIET", "1")
+
+BASELINE_GRAD_STEPS_PER_SEC = 1.0  # estimated reference 1-GPU train-only rate (see above)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+    from sheeprl_tpu.config.core import compose
+    from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
+
+    cfg = compose(
+        overrides=[
+            "exp=dreamer_v3",
+            "algo=dreamer_v3_S",
+            "algo.per_rank_batch_size=16",
+            "algo.per_rank_sequence_length=64",
+        ]
+    )
+    cfg.algo.cnn_keys.encoder = ["rgb"]
+    cfg.algo.mlp_keys.encoder = []
+
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="bf16-mixed", seed=0)
+
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    actions_dim = (6,)
+    world_model, actor, critic, params, _ = build_agent(ctx, actions_dim, False, cfg, obs_space)
+    train_step, init_opt_states = make_train_step(world_model, actor, critic, cfg, ["rgb"], [], {})
+    opt_states = init_opt_states(params)
+    moments = init_moments()
+
+    T, B = cfg.algo.per_rank_sequence_length, cfg.algo.per_rank_batch_size
+    rng = np.random.default_rng(0)
+    data = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 3, 64, 64), dtype=np.uint8)),
+        "actions": jnp.asarray(rng.random((T, B, 6)).astype(np.float32)),
+        "rewards": jnp.asarray(rng.random((T, B, 1)).astype(np.float32)),
+        "terminated": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+
+    train_jit = jax.jit(train_step)
+    key = jax.random.PRNGKey(0)
+    update_target = jnp.asarray(True)
+
+    # Warmup (compile + a few steps); device_get forces a full host-visible sync —
+    # block_until_ready alone has proven unreliable on the axon transport.
+    metrics = None
+    for _ in range(5):
+        key, sub = jax.random.split(key)
+        params, opt_states, moments, metrics = train_jit(params, opt_states, moments, data, sub, update_target)
+    jax.device_get(metrics)
+
+    n_steps = int(os.environ.get("BENCH_STEPS", "30"))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        key, sub = jax.random.split(key)
+        params, opt_states, moments, metrics = train_jit(params, opt_states, moments, data, sub, update_target)
+    jax.device_get(metrics)  # the last metrics depend on the whole step chain
+    elapsed = time.perf_counter() - t0
+
+    gsps = n_steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "dreamer_v3_S_grad_steps_per_sec",
+                "value": round(gsps, 4),
+                "unit": "grad_steps/s (batch 16 x seq 64, 64x64x3 obs, 1 chip)",
+                "vs_baseline": round(gsps / BASELINE_GRAD_STEPS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

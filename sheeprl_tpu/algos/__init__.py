@@ -2,3 +2,7 @@
 
 from sheeprl_tpu.algos.ppo import ppo as _ppo  # noqa: F401
 from sheeprl_tpu.algos.ppo import evaluate as _ppo_eval  # noqa: F401
+from sheeprl_tpu.algos.sac import sac as _sac  # noqa: F401
+from sheeprl_tpu.algos.sac import evaluate as _sac_eval  # noqa: F401
+from sheeprl_tpu.algos.dreamer_v3 import dreamer_v3 as _dv3  # noqa: F401
+from sheeprl_tpu.algos.dreamer_v3 import evaluate as _dv3_eval  # noqa: F401

@@ -1,0 +1,707 @@
+"""DreamerV3 agent modules (reference: ``/root/reference/sheeprl/algos/dreamer_v3/agent.py``).
+
+TPU-native design:
+
+* All modules are flax with ``setup``-style submodules so RSSM methods
+  (``dynamic`` / ``imagination`` / ``_representation`` / ``_transition``) can be invoked
+  through ``module.apply(params, ..., method=...)`` inside ``lax.scan`` bodies — the
+  reference's per-step python loops (``dreamer_v3.py:134-145``, ``:235-241``) become
+  scans inside ONE jitted train step.
+* Convolutions run NHWC (TPU layout); observations stay channel-first at rest for
+  reference parity and are transposed once at the encoder boundary.
+* Sampling is explicit-key (pure): every stochastic method takes a PRNG key.
+* The stateful ``PlayerDV3`` (reference ``agent.py:596-691``) becomes an explicit
+  carried-state pytree + a pure ``player_step`` function; per-env resets are mask-folds
+  of the learned initial state, exactly like ``RSSM.dynamic``'s ``is_first`` handling.
+
+Reference components mapped: ``CNNEncoder`` (``agent.py:42-97``), ``MLPEncoder``
+(``:100-151``), ``CNNDecoder`` (``:154-226``), ``MLPDecoder`` (``:229-278``),
+``RecurrentModel`` (``:281-341``), ``RSSM`` (``:344-498``), ``Actor`` (``:694-845``),
+``build_agent`` (``:935-1236``, incl. Hafner init ``:1170-1180``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.distributions import (
+    Independent,
+    Normal,
+    OneHotCategoricalStraightThrough,
+    TanhNormal,
+    unimix_logits,
+)
+from sheeprl_tpu.models.blocks import MLP, LayerNormGRUCell, _activation
+from sheeprl_tpu.utils.utils import symlog
+
+Dtype = Any
+
+
+def compute_stochastic_state(key: Optional[jax.Array], logits: jax.Array, discrete: int = 32, sample: bool = True) -> jax.Array:
+    """Sample the [..., stoch, discrete] one-hot state with straight-through gradients
+    (reference: ``dreamer_v2/utils.py:44-61``)."""
+    shaped = logits.reshape(*logits.shape[:-1], -1, discrete)
+    dist = OneHotCategoricalStraightThrough(shaped)
+    return dist.rsample(key) if sample else dist.mode
+
+
+class CNNEncoder(nn.Module):
+    """4-stage stride-2 conv trunk (reference ``agent.py:42-97``): 64×64 → 4×4,
+    channels ``m, 2m, 4m, 8m``, LayerNorm (channel-last) + SiLU, flattened output."""
+
+    channels_multiplier: int = 32
+    stages: int = 4
+    layer_norm: bool = True
+    norm_eps: float = 1e-3
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        # x: [..., H, W, C] float in [-0.5, 0.5]; flatten leading dims for conv.
+        lead = x.shape[:-3]
+        x = x.reshape(-1, *x.shape[-3:]).astype(self.dtype)
+        for i in range(self.stages):
+            ch = self.channels_multiplier * (2**i)
+            x = nn.Conv(ch, (4, 4), strides=(2, 2), padding=((1, 1), (1, 1)), use_bias=not self.layer_norm, dtype=self.dtype)(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.dtype)(x)
+            x = nn.silu(x)
+        return x.reshape(*lead, -1)
+
+
+class MLPEncoder(nn.Module):
+    """symlog → dense stack (reference ``agent.py:100-151``)."""
+
+    dense_units: int = 512
+    mlp_layers: int = 2
+    layer_norm: bool = True
+    norm_eps: float = 1e-3
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = symlog(x)
+        return MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="silu",
+            layer_norm=self.layer_norm,
+            norm_eps=self.norm_eps,
+            dtype=self.dtype,
+        )(x)
+
+
+class Encoder(nn.Module):
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_channels_multiplier: int = 32
+    cnn_stages: int = 4
+    dense_units: int = 512
+    mlp_layers: int = 2
+    layer_norm: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        feats = []
+        if self.cnn_keys:
+            # channel-first uint8/float [..., C, H, W] → NHWC in [-0.5, 0.5]
+            imgs = []
+            for k in self.cnn_keys:
+                img = obs[k]
+                if img.dtype == jnp.uint8:
+                    img = img.astype(jnp.float32) / 255.0 - 0.5
+                imgs.append(jnp.moveaxis(img, -3, -1))
+            x = jnp.concatenate(imgs, axis=-1)
+            feats.append(
+                CNNEncoder(
+                    channels_multiplier=self.cnn_channels_multiplier,
+                    stages=self.cnn_stages,
+                    layer_norm=self.layer_norm,
+                    dtype=self.dtype,
+                    name="cnn_encoder",
+                )(x)
+            )
+        if self.mlp_keys:
+            vec = jnp.concatenate([obs[k].astype(jnp.float32) for k in self.mlp_keys], axis=-1)
+            feats.append(
+                MLPEncoder(
+                    dense_units=self.dense_units,
+                    mlp_layers=self.mlp_layers,
+                    layer_norm=self.layer_norm,
+                    dtype=self.dtype,
+                    name="mlp_encoder",
+                )(vec)
+            )
+        return jnp.concatenate(feats, axis=-1).astype(jnp.float32)
+
+
+class CNNDecoder(nn.Module):
+    """Latent → stacked image reconstruction, mirror of the encoder
+    (reference ``agent.py:154-226``).  Output is channel-first for obs parity."""
+
+    output_shapes: Dict[str, Tuple[int, ...]]  # per-key [C, H, W]
+    channels_multiplier: int = 32
+    stages: int = 4
+    layer_norm: bool = True
+    norm_eps: float = 1e-3
+    image_size: int = 64
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> Dict[str, jax.Array]:
+        total_c = sum(s[0] for s in self.output_shapes.values())
+        h0 = self.image_size // (2**self.stages)
+        c0 = self.channels_multiplier * (2 ** (self.stages - 1))
+        x = nn.Dense(h0 * h0 * c0, dtype=self.dtype, name="latent_proj")(z.astype(self.dtype))
+        lead = x.shape[:-1]
+        x = x.reshape(-1, h0, h0, c0)
+        for i in reversed(range(self.stages - 1)):
+            ch = self.channels_multiplier * (2**i)
+            x = nn.ConvTranspose(ch, (4, 4), strides=(2, 2), padding="SAME", use_bias=not self.layer_norm, dtype=self.dtype)(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.dtype)(x)
+            x = nn.silu(x)
+        x = nn.ConvTranspose(total_c, (4, 4), strides=(2, 2), padding="SAME", dtype=self.dtype, name="head")(x)
+        x = jnp.moveaxis(x, -1, -3).astype(jnp.float32)  # [N, C, H, W]
+        x = x.reshape(*lead, *x.shape[-3:])
+        out, offset = {}, 0
+        for k, shape in self.output_shapes.items():
+            out[k] = x[..., offset : offset + shape[0], :, :]
+            offset += shape[0]
+        return out
+
+
+class MLPDecoder(nn.Module):
+    """Latent → per-key vector reconstructions (reference ``agent.py:229-278``)."""
+
+    output_shapes: Dict[str, Tuple[int, ...]]
+    dense_units: int = 512
+    mlp_layers: int = 2
+    layer_norm: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> Dict[str, jax.Array]:
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="silu",
+            layer_norm=self.layer_norm,
+            norm_eps=1e-3,
+            dtype=self.dtype,
+        )(z)
+        return {
+            k: nn.Dense(int(np.prod(shape)), dtype=self.dtype, name=f"head_{k}")(x).astype(jnp.float32)
+            for k, shape in self.output_shapes.items()
+        }
+
+
+class RecurrentModel(nn.Module):
+    """Dense+LN+SiLU → LayerNormGRUCell (reference ``agent.py:281-341``)."""
+
+    recurrent_state_size: int
+    dense_units: int = 512
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.mlp = MLP(
+            hidden_sizes=(self.dense_units,),
+            activation="silu",
+            layer_norm=True,
+            norm_eps=1e-3,
+            dtype=self.dtype,
+            name="input_proj",
+        )
+        self.rnn = LayerNormGRUCell(hidden_size=self.recurrent_state_size, layer_norm=True, dtype=self.dtype)
+
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = self.mlp(x)
+        h, _ = self.rnn(recurrent_state, feat)
+        return h.astype(jnp.float32)
+
+
+class RSSM(nn.Module):
+    """Recurrent State-Space Model (reference ``agent.py:344-498``)."""
+
+    stochastic_size: int = 32
+    discrete_size: int = 32
+    recurrent_state_size: int = 512
+    dense_units: int = 512
+    transition_hidden_size: int = 512
+    representation_hidden_size: int = 512
+    unimix: float = 0.01
+    learnable_initial_recurrent_state: bool = True
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        stoch_out = self.stochastic_size * self.discrete_size
+        self.recurrent_model = RecurrentModel(
+            recurrent_state_size=self.recurrent_state_size, dense_units=self.dense_units, dtype=self.dtype
+        )
+        self.representation_model = nn.Sequential(
+            [
+                MLP(
+                    hidden_sizes=(self.representation_hidden_size,),
+                    activation="silu",
+                    layer_norm=True,
+                    norm_eps=1e-3,
+                    dtype=self.dtype,
+                ),
+                nn.Dense(stoch_out, dtype=self.dtype, name="repr_logits"),
+            ]
+        )
+        self.transition_model = nn.Sequential(
+            [
+                MLP(
+                    hidden_sizes=(self.transition_hidden_size,),
+                    activation="silu",
+                    layer_norm=True,
+                    norm_eps=1e-3,
+                    dtype=self.dtype,
+                ),
+                nn.Dense(stoch_out, dtype=self.dtype, name="trans_logits"),
+            ]
+        )
+        if self.learnable_initial_recurrent_state:
+            self.initial_recurrent_state = self.param(
+                "initial_recurrent_state", nn.initializers.zeros, (self.recurrent_state_size,), jnp.float32
+            )
+        else:
+            self.initial_recurrent_state = jnp.zeros(self.recurrent_state_size, dtype=jnp.float32)
+
+    def _uniform_mix(self, logits: jax.Array) -> jax.Array:
+        shaped = logits.reshape(*logits.shape[:-1], self.stochastic_size, self.discrete_size)
+        mixed = unimix_logits(shaped, self.unimix)
+        return mixed.reshape(*logits.shape[:-1], -1)
+
+    def _representation(self, recurrent_state: jax.Array, embedded_obs: jax.Array, key: Optional[jax.Array], sample: bool = True):
+        logits = self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1)).astype(jnp.float32)
+        logits = self._uniform_mix(logits)
+        return logits, compute_stochastic_state(key, logits, self.discrete_size, sample)
+
+    def _transition(self, recurrent_state: jax.Array, key: Optional[jax.Array], sample: bool = True):
+        logits = self.transition_model(recurrent_state).astype(jnp.float32)
+        logits = self._uniform_mix(logits)
+        return logits, compute_stochastic_state(key, logits, self.discrete_size, sample)
+
+    def get_initial_states(self, batch_shape: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
+        """tanh'd learnable initial recurrent state + its prior mode
+        (reference ``agent.py:382-394``)."""
+        h0 = jnp.tanh(self.initial_recurrent_state)
+        h0 = jnp.broadcast_to(h0, (*batch_shape, self.recurrent_state_size))
+        _, z0 = self._transition(h0, key=None, sample=False)
+        return h0, z0.reshape(*batch_shape, -1)
+
+    def dynamic(
+        self,
+        posterior: jax.Array,  # [B, stoch*discrete] (flattened)
+        recurrent_state: jax.Array,  # [B, R]
+        action: jax.Array,  # [B, A]
+        embedded_obs: jax.Array,  # [B, E]
+        is_first: jax.Array,  # [B, 1]
+        key: jax.Array,
+    ):
+        """One posterior step (reference ``agent.py:396-435``): is-first masking resets
+        state/action to the learned initial state, then GRU → prior → posterior."""
+        action = (1 - is_first) * action
+        h0, z0 = self.get_initial_states(recurrent_state.shape[:-1])
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * h0
+        posterior = (1 - is_first) * posterior + is_first * z0
+        recurrent_state = self.recurrent_model(jnp.concatenate([posterior, action], -1), recurrent_state)
+        k1, k2 = jax.random.split(key)
+        prior_logits, prior = self._transition(recurrent_state, k1)
+        posterior_logits, posterior_sample = self._representation(recurrent_state, embedded_obs, k2)
+        posterior_flat = posterior_sample.reshape(*posterior_sample.shape[:-2], -1)
+        return recurrent_state, posterior_flat, prior, posterior_logits, prior_logits
+
+    def imagination(self, prior: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key: jax.Array):
+        """One prior-only step (reference ``agent.py:482-498``)."""
+        recurrent_state = self.recurrent_model(jnp.concatenate([prior, actions], -1), recurrent_state)
+        _, imagined = self._transition(recurrent_state, key)
+        return imagined.reshape(*imagined.shape[:-2], -1), recurrent_state
+
+
+class WorldModel(nn.Module):
+    """Encoder + RSSM + decoders + reward/continue heads under one params tree
+    (one optimizer, reference ``agent.py:707`` WorldModel wrapper)."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_shapes: Dict[str, Tuple[int, ...]]
+    mlp_shapes: Dict[str, Tuple[int, ...]]
+    cnn_channels_multiplier: int = 32
+    dense_units: int = 512
+    mlp_layers: int = 2
+    stochastic_size: int = 32
+    discrete_size: int = 32
+    recurrent_state_size: int = 512
+    transition_hidden_size: int = 512
+    representation_hidden_size: int = 512
+    unimix: float = 0.01
+    reward_bins: int = 255
+    image_size: int = 64
+    learnable_initial_recurrent_state: bool = True
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.encoder = Encoder(
+            cnn_keys=self.cnn_keys,
+            mlp_keys=self.mlp_keys,
+            cnn_channels_multiplier=self.cnn_channels_multiplier,
+            dense_units=self.dense_units,
+            mlp_layers=self.mlp_layers,
+            dtype=self.dtype,
+        )
+        self.rssm = RSSM(
+            stochastic_size=self.stochastic_size,
+            discrete_size=self.discrete_size,
+            recurrent_state_size=self.recurrent_state_size,
+            dense_units=self.dense_units,
+            transition_hidden_size=self.transition_hidden_size,
+            representation_hidden_size=self.representation_hidden_size,
+            unimix=self.unimix,
+            learnable_initial_recurrent_state=self.learnable_initial_recurrent_state,
+            dtype=self.dtype,
+        )
+        if self.cnn_keys:
+            self.observation_model_cnn = CNNDecoder(
+                output_shapes=self.cnn_shapes,
+                channels_multiplier=self.cnn_channels_multiplier,
+                image_size=self.image_size,
+                dtype=self.dtype,
+            )
+        if self.mlp_keys:
+            self.observation_model_mlp = MLPDecoder(
+                output_shapes=self.mlp_shapes,
+                dense_units=self.dense_units,
+                mlp_layers=self.mlp_layers,
+                dtype=self.dtype,
+            )
+        self.reward_model = nn.Sequential(
+            [
+                MLP(
+                    hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                    activation="silu",
+                    layer_norm=True,
+                    norm_eps=1e-3,
+                    dtype=self.dtype,
+                ),
+                nn.Dense(self.reward_bins, dtype=self.dtype, name="reward_head"),
+            ]
+        )
+        self.continue_model = nn.Sequential(
+            [
+                MLP(
+                    hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                    activation="silu",
+                    layer_norm=True,
+                    norm_eps=1e-3,
+                    dtype=self.dtype,
+                ),
+                nn.Dense(1, dtype=self.dtype, name="continue_head"),
+            ]
+        )
+
+    # -- method entry points for module.apply(..., method=...) --------------
+    def encode(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        return self.encoder(obs)
+
+    def decode(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_keys:
+            out.update(self.observation_model_cnn(latent))
+        if self.mlp_keys:
+            out.update(self.observation_model_mlp(latent))
+        return out
+
+    def reward(self, latent: jax.Array) -> jax.Array:
+        return self.reward_model(latent).astype(jnp.float32)
+
+    def continues(self, latent: jax.Array) -> jax.Array:
+        return self.continue_model(latent).astype(jnp.float32)
+
+    def dynamic(self, *args, **kwargs):
+        return self.rssm.dynamic(*args, **kwargs)
+
+    def imagination(self, *args, **kwargs):
+        return self.rssm.imagination(*args, **kwargs)
+
+    def initial_states(self, batch_shape):
+        return self.rssm.get_initial_states(batch_shape)
+
+    def representation(self, recurrent_state, embedded_obs, key, sample=True):
+        return self.rssm._representation(recurrent_state, embedded_obs, key, sample)
+
+    def __call__(self, obs: Dict[str, jax.Array], action: jax.Array, key: jax.Array):
+        """Init path: touch every submodule once."""
+        embed = self.encoder(obs)
+        batch_shape = embed.shape[:-1]
+        h0, z0 = self.rssm.get_initial_states(batch_shape)
+        h, z, prior, post_logits, prior_logits = self.rssm.dynamic(
+            z0, h0, action, embed, jnp.ones((*batch_shape, 1)), key
+        )
+        latent = jnp.concatenate([z, h], -1)
+        recon = self.decode(latent)
+        return self.reward(latent), self.continues(latent), recon
+
+
+class DreamerActor(nn.Module):
+    """Policy head over latent states (reference ``agent.py:694-845``)."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    distribution: str = "auto"
+    dense_units: int = 512
+    mlp_layers: int = 2
+    unimix: float = 0.01
+    init_std: float = 2.0
+    min_std: float = 0.1
+    max_std: float = 1.0
+    action_clip: float = 1.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False):
+        dist_type = self.distribution
+        if dist_type == "auto":
+            dist_type = "scaled_normal" if self.is_continuous else "discrete"
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="silu",
+            layer_norm=True,
+            norm_eps=1e-3,
+            dtype=self.dtype,
+        )(state)
+        if self.is_continuous:
+            out = nn.Dense(2 * sum(self.actions_dim), dtype=self.dtype, name="head")(x).astype(jnp.float32)
+            mean, std = jnp.split(out, 2, -1)
+            if dist_type == "tanh_normal":
+                mean = 5 * jnp.tanh(mean / 5)
+                std = jax.nn.softplus(std + self.init_std) + self.min_std
+                dist = TanhNormal(mean, std)
+            elif dist_type == "normal":
+                dist = Normal(mean, std)
+            else:  # scaled_normal
+                std = (self.max_std - self.min_std) * jax.nn.sigmoid(std + self.init_std) + self.min_std
+                dist = Normal(jnp.tanh(mean), std)
+            if greedy or key is None:
+                actions = dist.mode
+            else:
+                actions = dist.rsample(key)
+            if self.action_clip > 0:
+                clip = jnp.full_like(actions, self.action_clip)
+                actions = actions * jax.lax.stop_gradient(clip / jnp.maximum(clip, jnp.abs(actions)))
+            return (actions,), (dist,)
+        heads = [nn.Dense(d, dtype=self.dtype, name=f"head_{i}")(x).astype(jnp.float32) for i, d in enumerate(self.actions_dim)]
+        actions, dists = [], []
+        keys = jax.random.split(key, len(heads)) if key is not None else [None] * len(heads)
+        for logits, k in zip(heads, keys):
+            d = OneHotCategoricalStraightThrough(unimix_logits(logits, self.unimix))
+            dists.append(d)
+            actions.append(d.mode if (greedy or k is None) else d.rsample(k))
+        return tuple(actions), tuple(dists)
+
+
+class DreamerCritic(nn.Module):
+    """Two-hot value head (reference ``build_agent`` critic MLP, ``agent.py:1117-…``)."""
+
+    dense_units: int = 512
+    mlp_layers: int = 2
+    bins: int = 255
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, state: jax.Array) -> jax.Array:
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="silu",
+            layer_norm=True,
+            norm_eps=1e-3,
+            dtype=self.dtype,
+        )(state)
+        return nn.Dense(self.bins, dtype=self.dtype, name="head")(x).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Hafner initialization (reference utils.py:143-182 + agent.py:1170-1180)
+# ---------------------------------------------------------------------------
+
+
+def _variance_scaling_uniform(key, shape, dtype, scale: float):
+    fan_in, fan_out = shape[0], shape[-1]
+    denom = (fan_in + fan_out) / 2.0
+    limit = np.sqrt(3.0 * scale / denom)
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def apply_hafner_init(params: Dict[str, Any], key: jax.Array) -> Dict[str, Any]:
+    """Uniform(scale=1) re-init of output-head kernels (reference ``agent.py:1171-1180``):
+    actor heads (``head`` / ``head_i``), RSSM logits heads, continue head and decoder
+    heads.  Zero-init of reward/critic heads is done separately via
+    ``zero_init_head`` (which also zeroes the bias)."""
+    import flax
+
+    uniform_parents = {"repr_logits", "trans_logits", "continue_head", "head"}
+    flat = flax.traverse_util.flatten_dict(params)
+    keys = jax.random.split(key, len(flat))
+    new = {}
+    for i, (path, value) in enumerate(flat.items()):
+        parent = str(path[-2]) if len(path) >= 2 else ""
+        is_uniform = parent in uniform_parents or parent.startswith("head_")
+        if str(path[-1]) == "kernel" and is_uniform:
+            new[path] = _variance_scaling_uniform(keys[i], value.shape, value.dtype, 1.0)
+        else:
+            new[path] = value
+    return flax.traverse_util.unflatten_dict(new)
+
+
+def zero_init_head(params: Dict[str, Any], head_name: str = "head") -> Dict[str, Any]:
+    """Zero the kernel+bias of a module's top-level output head (critic/reward)."""
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(params)
+    new = {}
+    for path, value in flat.items():
+        name = "/".join(str(p) for p in path)
+        if f"{head_name}/kernel" in name or f"{head_name}/bias" in name:
+            new[path] = jnp.zeros_like(value)
+        else:
+            new[path] = value
+    return flax.traverse_util.unflatten_dict(new)
+
+
+# ---------------------------------------------------------------------------
+# Player: explicit carried state (reference PlayerDV3, agent.py:596-691)
+# ---------------------------------------------------------------------------
+
+
+class PlayerState(NamedTuple):
+    recurrent_state: jax.Array  # [n_envs, R]
+    stochastic_state: jax.Array  # [n_envs, S*D]
+    actions: jax.Array  # [n_envs, sum(actions_dim)]
+
+
+def parse_actions_dim(action_space: gymnasium.spaces.Space) -> Tuple[bool, Tuple[int, ...]]:
+    if isinstance(action_space, gymnasium.spaces.Box):
+        return True, (int(np.prod(action_space.shape)),)
+    if isinstance(action_space, gymnasium.spaces.Discrete):
+        return False, (int(action_space.n),)
+    if isinstance(action_space, gymnasium.spaces.MultiDiscrete):
+        return False, tuple(int(n) for n in action_space.nvec)
+    raise ValueError(f"Unsupported action space: {type(action_space)}")
+
+
+def build_agent(
+    ctx,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+):
+    """Construct world model / actor / critic modules + params (replicated)."""
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_shapes = {k: tuple(obs_space[k].shape) for k in cnn_keys}
+    mlp_shapes = {k: tuple(obs_space[k].shape) for k in mlp_keys}
+    wm_cfg = cfg.algo.world_model
+
+    world_model = WorldModel(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        cnn_shapes=cnn_shapes,
+        mlp_shapes=mlp_shapes,
+        cnn_channels_multiplier=wm_cfg.encoder.cnn_channels_multiplier,
+        dense_units=cfg.algo.dense_units,
+        mlp_layers=cfg.algo.mlp_layers,
+        stochastic_size=wm_cfg.stochastic_size,
+        discrete_size=wm_cfg.discrete_size,
+        recurrent_state_size=wm_cfg.recurrent_model.recurrent_state_size,
+        transition_hidden_size=wm_cfg.transition_model.hidden_size,
+        representation_hidden_size=wm_cfg.representation_model.hidden_size,
+        unimix=cfg.algo.unimix,
+        reward_bins=wm_cfg.reward_model.bins,
+        image_size=cfg.env.screen_size,
+        learnable_initial_recurrent_state=wm_cfg.learnable_initial_recurrent_state,
+        dtype=ctx.compute_dtype,
+    )
+    latent_size = (
+        wm_cfg.stochastic_size * wm_cfg.discrete_size + wm_cfg.recurrent_model.recurrent_state_size
+    )
+    actor = DreamerActor(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.get("type", "auto"),
+        dense_units=cfg.algo.actor.dense_units,
+        mlp_layers=cfg.algo.actor.mlp_layers,
+        unimix=cfg.algo.actor.unimix,
+        init_std=cfg.algo.actor.init_std,
+        min_std=cfg.algo.actor.min_std,
+        max_std=cfg.algo.actor.max_std,
+        action_clip=cfg.algo.actor.action_clip,
+        dtype=ctx.compute_dtype,
+    )
+    critic = DreamerCritic(
+        dense_units=cfg.algo.critic.dense_units,
+        mlp_layers=cfg.algo.critic.mlp_layers,
+        bins=cfg.algo.critic.bins,
+        dtype=ctx.compute_dtype,
+    )
+
+    dummy_obs = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((1, *cnn_shapes[k]), dtype=jnp.uint8)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, *mlp_shapes[k]), dtype=jnp.float32)
+    act_dim_sum = int(sum(actions_dim))
+    key = ctx.rng()
+    wm_params = world_model.init(key, dummy_obs, jnp.zeros((1, act_dim_sum)), ctx.rng())
+    actor_params = actor.init(ctx.rng(), jnp.zeros((1, latent_size)), ctx.rng())
+    critic_params = critic.init(ctx.rng(), jnp.zeros((1, latent_size)))
+
+    if cfg.algo.hafner_initialization:
+        wm_params = {"params": apply_hafner_init(wm_params["params"], ctx.rng())}
+        wm_params = {"params": zero_init_head(wm_params["params"], "reward_head")}
+        actor_params = {"params": apply_hafner_init(actor_params["params"], ctx.rng())}
+        critic_params = {"params": zero_init_head(critic_params["params"], "head")}
+
+    target_critic_params = jax.tree.map(lambda x: x, critic_params)
+    params = {
+        "world_model": ctx.replicate(wm_params),
+        "actor": ctx.replicate(actor_params),
+        "critic": ctx.replicate(critic_params),
+        "target_critic": ctx.replicate(target_critic_params),
+    }
+    return world_model, actor, critic, params, latent_size
+
+
+def make_player_step(world_model: WorldModel, actor: DreamerActor, actions_dim: Sequence[int], discrete_size: int):
+    """Build the pure player-step function: (params, state, obs, is_first, key) →
+    (env_actions, stored_actions, new_state)."""
+
+    def player_step(params, state: PlayerState, obs, is_first, key, greedy: bool = False):
+        k_repr, k_act = jax.random.split(key)
+        wm, ap = params["world_model"], params["actor"]
+        embed = world_model.apply(wm, obs, method=WorldModel.encode)
+        h0, z0 = world_model.apply(wm, state.recurrent_state.shape[:-1], method=WorldModel.initial_states)
+        recurrent = (1 - is_first) * state.recurrent_state + is_first * h0
+        stoch = (1 - is_first) * state.stochastic_state + is_first * z0
+        prev_actions = (1 - is_first) * state.actions
+        recurrent = world_model.apply(
+            wm,
+            jnp.concatenate([stoch, prev_actions], -1),
+            recurrent,
+            method=lambda m, x, h: m.rssm.recurrent_model(x, h),
+        )
+        _, stoch_sample = world_model.apply(wm, recurrent, embed, k_repr, method=WorldModel.representation)
+        stoch = stoch_sample.reshape(*stoch_sample.shape[:-2], -1)
+        latent = jnp.concatenate([stoch, recurrent], -1)
+        actions, _ = actor.apply(ap, latent, k_act, greedy)
+        stored = jnp.concatenate(actions, -1)
+        return actions, stored, PlayerState(recurrent, stoch, stored)
+
+    return player_step

@@ -1,0 +1,56 @@
+"""DreamerV3 world-model loss (reference: ``/root/reference/sheeprl/algos/dreamer_v3/loss.py``).
+
+Pure jnp.  The two-sided KL balancing with free nats (reference ``loss.py:63-75``) is the
+heart of the algorithm — stop-gradient placement is exactly mirrored:
+``dyn_loss = KL(sg(post) || prior)``, ``repr_loss = KL(post || sg(prior))``."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def categorical_kl(post_logits: jax.Array, prior_logits: jax.Array) -> jax.Array:
+    """KL over the last (discrete) axis, summed over the stochastic axis.
+    Inputs ``[..., stoch, discrete]`` raw logits → output ``[...]``."""
+    post_logp = jax.nn.log_softmax(post_logits, -1)
+    prior_logp = jax.nn.log_softmax(prior_logits, -1)
+    kl = (jnp.exp(post_logp) * (post_logp - prior_logp)).sum(-1)
+    return kl.sum(-1)
+
+
+def reconstruction_loss(
+    observation_log_probs: jax.Array,  # [T, B] summed over obs keys
+    reward_log_prob: jax.Array,  # [T, B]
+    priors_logits: jax.Array,  # [T, B, stoch, discrete]
+    posteriors_logits: jax.Array,  # [T, B, stoch, discrete]
+    kl_dynamic: float = 0.5,
+    kl_representation: float = 0.1,
+    kl_free_nats: float = 1.0,
+    kl_regularizer: float = 1.0,
+    continue_log_prob: Optional[jax.Array] = None,  # [T, B]
+    continue_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    observation_loss = -observation_log_probs
+    reward_loss = -reward_log_prob
+    kl = categorical_kl(jax.lax.stop_gradient(posteriors_logits), priors_logits)
+    dyn_loss = kl_dynamic * jnp.maximum(kl, kl_free_nats)
+    repr_kl = categorical_kl(posteriors_logits, jax.lax.stop_gradient(priors_logits))
+    repr_loss = kl_representation * jnp.maximum(repr_kl, kl_free_nats)
+    kl_loss = dyn_loss + repr_loss
+    if continue_log_prob is not None:
+        continue_loss = continue_scale_factor * -continue_log_prob
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = (kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss).mean()
+    metrics = {
+        "Loss/world_model_loss": rec_loss,
+        "Loss/observation_loss": observation_loss.mean(),
+        "Loss/reward_loss": reward_loss.mean(),
+        "Loss/state_loss": kl_loss.mean(),
+        "Loss/continue_loss": continue_loss.mean(),
+        "State/kl": kl.mean(),
+    }
+    return rec_loss, metrics

@@ -1,0 +1,102 @@
+"""DreamerV3 helpers (reference: ``/root/reference/sheeprl/algos/dreamer_v3/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
+
+
+def init_moments() -> Dict[str, jax.Array]:
+    return {"low": jnp.zeros(()), "high": jnp.zeros(())}
+
+
+def update_moments(
+    state: Dict[str, jax.Array],
+    x: jax.Array,
+    decay: float = 0.99,
+    max_: float = 1.0,
+    percentile_low: float = 0.05,
+    percentile_high: float = 0.95,
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Percentile return normalizer (reference ``utils.py:40-63`` ``Moments``).
+
+    The reference all-gathers across ranks before the quantile; here ``x`` is a global
+    (mesh-sharded) array inside jit, so the quantile already spans every shard.
+    Returns ``(offset, invscale, new_state)``.
+    """
+    x = jax.lax.stop_gradient(x.astype(jnp.float32))
+    low = jnp.quantile(x, percentile_low)
+    high = jnp.quantile(x, percentile_high)
+    new_low = decay * state["low"] + (1 - decay) * low
+    new_high = decay * state["high"] + (1 - decay) * high
+    invscale = jnp.maximum(1.0 / max_, new_high - new_low)
+    return new_low, invscale, {"low": new_low, "high": new_high}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], cnn_keys: Sequence[str], mlp_keys: Sequence[str], num_envs: int = 1
+) -> Dict[str, jax.Array]:
+    """numpy env obs → [num_envs, ...] device arrays; images stay uint8 channel-first
+    (the encoder normalises), vectors flattened float."""
+    out: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        v = np.asarray(obs[k])
+        out[k] = jnp.asarray(v.reshape(num_envs, -1, *v.shape[-2:]))
+    for k in mlp_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k], dtype=np.float32).reshape(num_envs, -1))
+    return out
+
+
+def test(player_step, params, player_state_init, ctx, cfg, log_dir: str, greedy: bool = True, test_name: str = "test"):
+    """Greedy single-env rollout (reference ``utils.py:94-139``)."""
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, test_name)()
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    step_jit = jax.jit(player_step, static_argnames=("greedy",))
+
+    obs, _ = env.reset(seed=cfg.seed)
+    state = player_state_init(1)
+    is_first = jnp.ones((1, 1))
+    done, cum_reward = False, 0.0
+    while not done:
+        obs_t = prepare_obs({k: np.asarray(v)[None] for k, v in obs.items()}, cnn_keys, mlp_keys, 1)
+        actions, _, state = step_jit(params, state, obs_t, is_first, ctx.rng(), greedy=greedy)
+        is_first = jnp.zeros((1, 1))
+        env_action = _to_env_action(actions, env.action_space)
+        obs, reward, terminated, truncated, _ = env.step(env_action)
+        done = bool(terminated or truncated)
+        cum_reward += float(reward)
+    env.close()
+    return cum_reward
+
+
+def _to_env_action(actions: Sequence[jax.Array], action_space) -> Any:
+    import gymnasium
+
+    acts = [np.asarray(jax.device_get(a))[0] for a in actions]
+    if isinstance(action_space, gymnasium.spaces.Box):
+        return acts[0].reshape(action_space.shape)
+    if isinstance(action_space, gymnasium.spaces.Discrete):
+        return int(acts[0].argmax(-1))
+    return np.stack([a.argmax(-1) for a in acts])

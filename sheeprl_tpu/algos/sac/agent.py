@@ -1,0 +1,99 @@
+"""SAC agent (reference: ``/root/reference/sheeprl/algos/sac/agent.py``).
+
+TPU-native design decisions:
+
+* the twin critics (reference ``SACCritic`` instances in a ModuleList, ``agent.py:145``)
+  are ONE ``nn.vmap``-ensembled module — a single batched matmul per layer over the
+  ensemble axis instead of N sequential small matmuls (MXU-friendly);
+* target networks are a second params pytree updated with a fused EMA inside the jitted
+  step (reference ``:265`` does a python-side polyak loop);
+* the temperature ``log_alpha`` is a 0-d param pytree with its own optimizer
+  (reference ``:145`` keeps it as an nn.Parameter).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.distributions import TanhNormal
+from sheeprl_tpu.models.blocks import MLP
+
+LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
+
+
+class SACActor(nn.Module):
+    act_dim: int
+    hidden_size: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), activation="relu", dtype=self.dtype)(obs)
+        out = nn.Dense(2 * self.act_dim, dtype=self.dtype)(x).astype(jnp.float32)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        # tanh-clamped log-std in [LOG_STD_MIN, LOG_STD_MAX] (reference agent.py:88-92)
+        log_std = jnp.tanh(log_std)
+        log_std = LOG_STD_MIN + 0.5 * (LOG_STD_MAX - LOG_STD_MIN) * (log_std + 1)
+        return mean, log_std
+
+    def dist(self, mean: jax.Array, log_std: jax.Array) -> TanhNormal:
+        return TanhNormal(mean, jnp.exp(log_std))
+
+
+class SACCriticEnsemble(nn.Module):
+    n_critics: int = 2
+    hidden_size: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        ensemble = nn.vmap(
+            MLP,
+            in_axes=None,
+            out_axes=0,
+            axis_size=self.n_critics,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )
+        # [n_critics, batch, 1]
+        return ensemble(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=1,
+            activation="relu",
+            dtype=self.dtype,
+        )(x).astype(jnp.float32)
+
+
+def build_agent(
+    ctx,
+    action_space: gymnasium.spaces.Space,
+    obs_space: gymnasium.spaces.Dict,
+    cfg: Dict[str, Any],
+) -> Tuple[SACActor, SACCriticEnsemble, Dict[str, Any]]:
+    if not isinstance(action_space, gymnasium.spaces.Box):
+        raise ValueError("SAC supports continuous (Box) action spaces only (reference parity)")
+    act_dim = int(np.prod(action_space.shape))
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
+
+    actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size, dtype=ctx.compute_dtype)
+    critic = SACCriticEnsemble(
+        n_critics=cfg.algo.critic.n, hidden_size=cfg.algo.critic.hidden_size, dtype=ctx.compute_dtype
+    )
+    dummy_obs = jnp.zeros((1, obs_dim))
+    dummy_act = jnp.zeros((1, act_dim))
+    params = {
+        "actor": actor.init(ctx.rng(), dummy_obs),
+        "critic": critic.init(ctx.rng(), dummy_obs, dummy_act),
+        "log_alpha": jnp.asarray(jnp.log(cfg.algo.alpha.alpha), dtype=jnp.float32),
+    }
+    params["critic_target"] = jax.tree.map(lambda x: x, params["critic"])
+    params = ctx.replicate(params)
+    return actor, critic, params

@@ -1,0 +1,22 @@
+"""SAC losses (reference: ``/root/reference/sheeprl/algos/sac/sac.py:32-79``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def critic_loss(qs: jax.Array, target: jax.Array) -> jax.Array:
+    """Sum of per-critic MSEs against the shared target; ``qs``: [n_critics, B, 1]."""
+    return ((qs - target[None]) ** 2).mean(axis=(1, 2)).sum()
+
+
+def actor_loss(alpha: jax.Array, logp: jax.Array, min_q: jax.Array) -> jax.Array:
+    return (alpha * logp - min_q).mean()
+
+
+def alpha_loss(log_alpha: jax.Array, logp: jax.Array, target_entropy: float) -> jax.Array:
+    """α loss with stop-gradient on the log-probs; the cross-rank mean of the α gradient
+    (reference all_reduce at ``sac.py:73``) falls out of the global batch mean under
+    GSPMD."""
+    return -(jnp.exp(log_alpha) * (jax.lax.stop_gradient(logp) + target_entropy)).mean()

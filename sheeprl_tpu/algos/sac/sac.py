@@ -1,0 +1,308 @@
+"""SAC training loop (reference: ``/root/reference/sheeprl/algos/sac/sac.py:81-…``).
+
+TPU-first structure: each iteration steps the envs once, then runs ALL of this
+iteration's gradient steps in one jitted call — the host samples
+``G × batch`` transitions from the replay buffer, ships them as a ``[G, B, ...]``
+block, and a ``lax.scan`` consumes one minibatch per step (the reference python-loops
+``train()`` G times, ``sac.py:343-355``).  The EMA target update is fused into the same
+scan.  The replay-ratio ``Ratio`` governor decides G exactly as in the reference."""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.ppo import make_optimizer
+from sheeprl_tpu.algos.sac.agent import build_agent
+from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.config.core import save_config
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_vector_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio
+
+
+@register_algorithm(name="sac")
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    act_low, act_high = act_space.low, act_space.high
+    rescale = np.isfinite(act_low).all() and np.isfinite(act_high).all()
+
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    act_dim = int(np.prod(act_space.shape))
+    target_entropy = -act_dim
+
+    actor_opt = make_optimizer(cfg.algo.actor.optimizer, cfg.algo.get("max_grad_norm", 0.0))
+    critic_opt = make_optimizer(cfg.algo.critic.optimizer, cfg.algo.get("max_grad_norm", 0.0))
+    alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
+    opt_state = ctx.replicate(
+        {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        }
+    )
+
+    num_envs = cfg.env.num_envs
+    world = jax.process_count()
+    # Per-env row count: total capacity is cfg.buffer.size transitions across all envs
+    # and ranks (reference sac.py:183).
+    rb = ReplayBuffer(
+        max(int(cfg.buffer.size) // max(num_envs * world, 1), 1),
+        num_envs,
+        obs_keys=mlp_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+
+    tau = cfg.algo.tau
+    gamma = cfg.algo.gamma
+    batch_size = cfg.algo.per_rank_batch_size
+
+    @jax.jit
+    def act_fn(p, obs, key):
+        mean, log_std = actor.apply(p, obs)
+        dist = actor.dist(mean, log_std)
+        return dist.sample(key)
+
+    def _losses(p, batch, key):
+        key_next, key_new = jax.random.split(key)
+        obs, action, reward, done, next_obs = (
+            batch["obs"],
+            batch["actions"],
+            batch["rewards"],
+            batch["dones"],
+            batch["next_obs"],
+        )
+        alpha = jnp.exp(p["log_alpha"])
+
+        # --- critic target (reference sac.py:39-47)
+        next_mean, next_log_std = actor.apply(p["actor"], next_obs)
+        next_act, next_logp = actor.dist(next_mean, next_log_std).sample_and_log_prob(key_next)
+        next_logp = next_logp.sum(-1, keepdims=True)
+        q_next = critic.apply(p["critic_target"], next_obs, next_act).min(axis=0)
+        target = reward + (1.0 - done) * gamma * (q_next - alpha * next_logp)
+        target = jax.lax.stop_gradient(target)
+
+        def c_loss(cp):
+            qs = critic.apply(cp, obs, action)
+            return critic_loss(qs, target)
+
+        # --- actor (reference sac.py:50-58)
+        def a_loss(ap):
+            mean, log_std = actor.apply(ap, obs)
+            new_act, logp = actor.dist(mean, log_std).sample_and_log_prob(key_new)
+            logp = logp.sum(-1, keepdims=True)
+            min_q = critic.apply(p["critic"], obs, new_act).min(axis=0)
+            return actor_loss(alpha, logp, min_q), logp
+
+        # --- alpha (reference sac.py:61-79)
+        def t_loss(log_a, logp):
+            return alpha_loss(log_a, logp, target_entropy)
+
+        return c_loss, a_loss, t_loss
+
+    @jax.jit
+    def train_fn(p, o_state, batches, key):
+        def step(carry, batch):
+            p, o_state = carry
+            c_loss, a_loss, t_loss = _losses(p, batch, batch.pop("_key"))
+
+            cl, c_grads = jax.value_and_grad(c_loss)(p["critic"])
+            c_updates, new_c_state = critic_opt.update(c_grads, o_state["critic"], p["critic"])
+            p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
+
+            (al, logp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
+            a_updates, new_a_state = actor_opt.update(a_grads, o_state["actor"], p["actor"])
+            p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
+
+            tl, t_grads = jax.value_and_grad(t_loss)(p["log_alpha"], logp)
+            t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
+            p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
+
+            # Fused EMA target update (reference agent.py:265).
+            p = {
+                **p,
+                "critic_target": jax.tree.map(
+                    lambda tp, cp: (1 - tau) * tp + tau * cp, p["critic_target"], p["critic"]
+                ),
+            }
+            o_state = {"actor": new_a_state, "critic": new_c_state, "alpha": new_t_state}
+            return (p, o_state), {"Loss/value_loss": cl, "Loss/policy_loss": al, "Loss/alpha_loss": tl}
+
+        g = batches["obs"].shape[0]
+        batches["_key"] = jax.random.split(key, g)
+        (p, o_state), metrics = jax.lax.scan(step, (p, o_state), batches)
+        return p, o_state, jax.tree.map(jnp.mean, metrics)
+
+    # ------------------------------------------------------------------ counters
+    policy_steps_per_iter = num_envs * world
+    total_steps = int(cfg.algo.total_steps)
+    num_iters = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_iters = max(learning_starts - 1, 0)
+
+    start_iter = 1
+    policy_step = 0
+    last_log = 0
+    last_checkpoint = 0
+    cumulative_grad_steps = 0
+    if cfg.checkpoint.get("resume_from"):
+        state = CheckpointManager.load(
+            cfg.checkpoint.resume_from,
+            templates={"params": jax.device_get(params), "opt_state": jax.device_get(opt_state)},
+        )
+        params = ctx.replicate(state["params"])
+        opt_state = ctx.replicate(state["opt_state"])
+        ratio.load_state_dict(state["ratio"])
+        start_iter = state["iter_num"] + 1
+        policy_step = state["policy_step"]
+        last_log = state.get("last_log", 0)
+        last_checkpoint = state.get("last_checkpoint", 0)
+        cumulative_grad_steps = state.get("cumulative_grad_steps", 0)
+        learning_starts += start_iter
+        if cfg.buffer.checkpoint and "rb" in state:
+            rb.load_state_dict(state["rb"])
+
+    obs, _ = envs.reset(seed=cfg.seed + rank)
+    step_data: Dict[str, np.ndarray] = {}
+
+    for iter_num in range(start_iter, num_iters + 1):
+        env_t0 = time.perf_counter()
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = np.stack([act_space.sample() for _ in range(num_envs)])
+                tanh_actions = (
+                    2 * (actions - act_low) / (act_high - act_low) - 1 if rescale else actions
+                )
+            else:
+                obs_t = prepare_obs(obs, mlp_keys)
+                tanh_actions = np.asarray(jax.device_get(act_fn(params["actor"], obs_t, ctx.rng())))
+                actions = (
+                    act_low + (tanh_actions + 1) * 0.5 * (act_high - act_low) if rescale else tanh_actions
+                )
+            next_obs, reward, terminated, truncated, info = envs.step(actions)
+            done = np.logical_or(terminated, truncated)
+
+            # Store the TRUE next observation for done envs (SAME_STEP autoreset
+            # returns the reset obs; reference uses final_observation similarly).
+            real_next = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
+            if done.any() and "final_obs" in info:
+                for i in np.nonzero(done)[0]:
+                    if info["final_obs"][i] is not None:
+                        for k in mlp_keys:
+                            real_next[k][i] = np.asarray(info["final_obs"][i][k])
+
+            for k in mlp_keys:
+                step_data[k] = np.asarray(obs[k])[None]
+                step_data[f"next_{k}"] = real_next[k][None]
+            step_data["actions"] = tanh_actions.astype(np.float32)[None]
+            step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
+            # Truncated episodes still bootstrap (done=0 in the TD target).
+            step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            obs = next_obs
+            policy_step += policy_steps_per_iter
+            record_episode_stats(aggregator, info)
+        env_time = time.perf_counter() - env_t0
+
+        train_time = 0.0
+        grad_steps = 0
+        if iter_num >= learning_starts:
+            # Offset by the prefill so the governor doesn't demand the whole
+            # prefill's worth of gradient steps in one burst (reference sac.py:301).
+            grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
+            if grad_steps > 0:
+                # next_{k} keys are stored explicitly (with final-obs correction), so no
+                # derived next-obs sampling is needed.
+                sample = rb.sample(batch_size * grad_steps)
+                batches = {
+                    "obs": np.concatenate(
+                        [sample[k].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1
+                    ),
+                    "next_obs": np.concatenate(
+                        [sample[f"next_{k}"].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1
+                    ),
+                    "actions": sample["actions"].reshape(grad_steps, batch_size, -1),
+                    "rewards": sample["rewards"].reshape(grad_steps, batch_size, 1),
+                    "dones": sample["dones"].reshape(grad_steps, batch_size, 1),
+                }
+                batches = {k: jnp.asarray(v) for k, v in batches.items()}
+                with timer("Time/train_time"):
+                    t0 = time.perf_counter()
+                    params, opt_state, train_metrics = train_fn(params, opt_state, batches, ctx.rng())
+                    train_metrics = jax.device_get(train_metrics)
+                    train_time = time.perf_counter() - t0
+                cumulative_grad_steps += grad_steps
+                for k, v in train_metrics.items():
+                    aggregator.update(k, float(v))
+
+        if logger is not None and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
+        ):
+            metrics = aggregator.compute()
+            if train_time > 0:
+                metrics["Time/sps_train"] = grad_steps / train_time
+            metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
+            metrics["Params/replay_ratio"] = (
+                cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
+            )
+            logger.log_metrics(metrics, policy_step)
+            aggregator.reset()
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or iter_num == num_iters
+            and cfg.checkpoint.save_last
+        ):
+            state = {
+                "params": params,
+                "opt_state": opt_state,
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": policy_step,
+                "cumulative_grad_steps": cumulative_grad_steps,
+            }
+            if cfg.buffer.checkpoint:
+                state["rb"] = rb.state_dict()
+            ckpt_manager.save(policy_step, state)
+            last_checkpoint = policy_step
+
+    envs.close()
+    if cfg.algo.run_test and ctx.is_global_zero:
+        reward = test(actor, params, ctx, cfg, log_dir)
+        if logger is not None:
+            logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if logger is not None:
+        logger.close()

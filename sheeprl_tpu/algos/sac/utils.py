@@ -1,0 +1,51 @@
+"""SAC helpers (reference: ``/root/reference/sheeprl/algos/sac/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(obs: Dict[str, np.ndarray], mlp_keys: Sequence[str]) -> jax.Array:
+    """Concatenate (flattened) vector keys: SAC is vector-obs only (reference parity)."""
+    arrs = [np.asarray(obs[k], dtype=np.float32) for k in mlp_keys]
+    arrs = [a.reshape(a.shape[0], -1) if a.ndim > 1 else a[:, None] for a in arrs]
+    return jnp.asarray(np.concatenate(arrs, axis=-1))
+
+
+def test(actor, params, ctx, cfg, log_dir: str) -> float:
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+
+    @jax.jit
+    def policy(p, obs):
+        mean, _ = actor.apply(p, obs)
+        return jnp.tanh(mean)
+
+    obs, _ = env.reset(seed=cfg.seed)
+    done, cum_reward = False, 0.0
+    while not done:
+        obs_t = prepare_obs({k: np.asarray(v)[None] for k, v in obs.items()}, mlp_keys)
+        act = np.asarray(jax.device_get(policy(params["actor"], obs_t)))[0]
+        low, high = env.action_space.low, env.action_space.high
+        if np.isfinite(low).all() and np.isfinite(high).all():
+            act = low + (act + 1) * 0.5 * (high - low)
+        obs, reward, terminated, truncated, _ = env.step(act)
+        done = bool(terminated or truncated)
+        cum_reward += float(reward)
+    env.close()
+    return cum_reward

@@ -72,3 +72,55 @@ def test_ppo_evaluate_roundtrip(tmp_path):
     ckpts = sorted(tmp_path.rglob("ckpt_*"))
     assert ckpts
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+SAC_ARGS = [
+    "exp=sac",
+    "env=continuous_dummy",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.hidden_size=8",
+    "algo.per_rank_batch_size=8",
+    "algo.learning_starts=4",
+    "algo.total_steps=16",
+    "buffer.size=256",
+]
+
+
+def test_sac_dummy_env(tmp_path):
+    run(SAC_ARGS + standard_args(tmp_path, extra=["dry_run=False"]))
+
+
+def test_sac_resume_and_evaluate(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(SAC_ARGS + standard_args(tmp_path, extra=["dry_run=False"]))
+    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    assert ckpts
+    run(SAC_ARGS + [f"checkpoint.resume_from={ckpts[-1]}", "algo.total_steps=24"] + standard_args(tmp_path, extra=["dry_run=False"]))
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+DV3_ARGS = [
+    "exp=dreamer_v3_dummy",
+    "algo.total_steps=32",
+    "algo.learning_starts=16",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_dreamer_v3_dummy_envs(tmp_path, env_id):
+    run(DV3_ARGS + [f"env={env_id}"] + standard_args(tmp_path, extra=["dry_run=False"]))
+
+
+def test_dreamer_v3_resume_and_evaluate(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(DV3_ARGS + ["env=discrete_dummy"] + standard_args(tmp_path, extra=["dry_run=False"]))
+    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    assert ckpts
+    run(
+        DV3_ARGS
+        + ["env=discrete_dummy", f"checkpoint.resume_from={ckpts[-1]}", "algo.total_steps=48"]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
