@@ -1,0 +1,213 @@
+"""A2C (reference: ``/root/reference/sheeprl/algos/a2c/a2c.py``).
+
+Shares the PPO agent and rollout machinery.  The reference accumulates gradients across
+minibatches and steps once per rollout (``a2c.py:63-110``) — on TPU that's simply ONE
+jitted full-batch gradient step with the configured ``loss_reduction``."""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import build_agent
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, value_loss
+from sheeprl_tpu.algos.ppo.ppo import make_optimizer
+from sheeprl_tpu.algos.ppo.utils import log_prob_and_entropy, prepare_obs, sample_actions, test
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.config.core import save_config
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_vector_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, normalize_tensor
+
+AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss"}
+
+
+@register_algorithm(name="a2c")
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    agent, params = build_agent(ctx, act_space, obs_space, cfg)
+    is_continuous = agent.is_continuous
+    opt = make_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    opt_state = ctx.replicate(opt.init(params))
+
+    num_envs = cfg.env.num_envs
+    rollout_steps = cfg.algo.rollout_steps
+    world = jax.process_count()
+    policy_steps_per_iter = int(num_envs * rollout_steps * world)
+    num_updates = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1) if not cfg.dry_run else 1
+
+    rb = ReplayBuffer(
+        rollout_steps,
+        num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+
+    gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
+    reduction = cfg.algo.loss_reduction
+
+    @jax.jit
+    def act_fn(p, obs, key):
+        actor_out, value = agent.apply(p, obs)
+        env_act, stored_act, logprob = sample_actions(key, actor_out, is_continuous)
+        return env_act, stored_act, logprob, value[..., 0]
+
+    @jax.jit
+    def values_fn(p, obs):
+        return agent.apply(p, obs)[1][..., 0]
+
+    gae_fn = jax.jit(lambda r, v, d, nv: gae(r, v, d, nv, rollout_steps, gamma, gae_lambda))
+
+    def loss_fn(p, data):
+        actor_out, new_values = agent.apply(p, {k: data[k] for k in obs_keys})
+        logprob, entropy = log_prob_and_entropy(actor_out, data["actions"], is_continuous)
+        adv = data["advantages"]
+        if cfg.algo.normalize_advantages:
+            adv = normalize_tensor(adv)
+        obj = logprob * adv
+        pg = -(obj.mean() if reduction == "mean" else obj.sum())
+        vf = value_loss(new_values[..., 0], data["values"], data["returns"], 0.0, False, reduction)
+        ent = entropy_loss(entropy, reduction)
+        total = pg + cfg.algo.vf_coef * vf + cfg.algo.ent_coef * ent
+        return total, {"Loss/policy_loss": pg, "Loss/value_loss": vf}
+
+    @jax.jit
+    def train_fn(p, o_state, data):
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, data)
+        updates, o_state = opt.update(grads, o_state, p)
+        return optax.apply_updates(p, updates), o_state, aux
+
+    start_update, policy_step, last_log, last_checkpoint = 1, 0, 0, 0
+    if cfg.checkpoint.get("resume_from"):
+        state = CheckpointManager.load(
+            cfg.checkpoint.resume_from, templates={"params": jax.device_get(params), "opt_state": jax.device_get(opt_state)}
+        )
+        params = ctx.replicate(state["params"])
+        opt_state = ctx.replicate(state["opt_state"])
+        start_update = state["update"] + 1
+        policy_step = state["policy_step"]
+        last_log = state.get("last_log", 0)
+        last_checkpoint = state.get("last_checkpoint", 0)
+
+    obs, _ = envs.reset(seed=cfg.seed + rank)
+    step_data: Dict[str, np.ndarray] = {}
+
+    for update in range(start_update, num_updates + 1):
+        env_t0 = time.perf_counter()
+        with timer("Time/env_interaction_time"):
+            for _ in range(rollout_steps):
+                obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
+                env_act, _, logprob, value = act_fn(params, obs_t, ctx.rng())
+                env_act_np = np.asarray(jax.device_get(env_act))
+                if is_continuous:
+                    low, high = act_space.low, act_space.high
+                    env_actions = np.clip(env_act_np, low, high) if np.isfinite(low).all() else env_act_np
+                elif len(agent.action_dims) == 1:
+                    env_actions = env_act_np[..., 0]
+                else:
+                    env_actions = env_act_np
+                next_obs, reward, terminated, truncated, info = envs.step(env_actions)
+                done = np.logical_or(terminated, truncated)
+                reward = np.asarray(reward, dtype=np.float32).reshape(num_envs)
+                if truncated.any() and "final_obs" in info:
+                    trunc_idx = np.nonzero(truncated)[0]
+                    final_obs = {
+                        k: np.stack([np.asarray(info["final_obs"][i][k]) for i in trunc_idx]) for k in obs_keys
+                    }
+                    v_final = np.asarray(jax.device_get(values_fn(params, prepare_obs(final_obs, cnn_keys, mlp_keys))))
+                    reward[trunc_idx] += gamma * v_final
+                for k in obs_keys:
+                    step_data[k] = np.asarray(obs[k])[None]
+                step_data["actions"] = env_act_np.reshape(num_envs, -1).astype(np.float32)[None]
+                step_data["values"] = np.asarray(jax.device_get(value)).reshape(num_envs, 1)[None]
+                step_data["rewards"] = reward.reshape(num_envs, 1)[None]
+                step_data["dones"] = done.astype(np.float32).reshape(num_envs, 1)[None]
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                obs = next_obs
+                policy_step += num_envs * world
+                record_episode_stats(aggregator, info)
+        env_time = time.perf_counter() - env_t0
+
+        local = rb.to_tensor()
+        next_value = values_fn(params, prepare_obs(obs, cnn_keys, mlp_keys))[:, None]
+        returns, advantages = gae_fn(local["rewards"], local["values"], local["dones"], next_value)
+        batch_n = rollout_steps * num_envs
+        data = {
+            **{k: local[k] for k in obs_keys},
+            "actions": local["actions"],
+            "values": local["values"][..., 0],
+            "returns": returns[..., 0],
+            "advantages": advantages[..., 0],
+        }
+        data = jax.tree.map(lambda x: x.reshape(batch_n, *x.shape[2:]), data)
+
+        with timer("Time/train_time"):
+            t0 = time.perf_counter()
+            params, opt_state, train_metrics = train_fn(params, opt_state, data)
+            train_metrics = jax.device_get(train_metrics)
+            train_time = time.perf_counter() - t0
+        for k, v in train_metrics.items():
+            aggregator.update(k, float(v))
+
+        if logger is not None and (policy_step - last_log >= cfg.metric.log_every or update == num_updates or cfg.dry_run):
+            metrics = aggregator.compute()
+            metrics["Time/sps_train"] = 1.0 / train_time if train_time > 0 else 0.0
+            metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
+            logger.log_metrics(metrics, policy_step)
+            aggregator.reset()
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or update == num_updates
+            and cfg.checkpoint.save_last
+        ):
+            ckpt_manager.save(
+                policy_step,
+                {
+                    "params": params,
+                    "opt_state": opt_state,
+                    "update": update,
+                    "policy_step": policy_step,
+                    "last_log": last_log,
+                    "last_checkpoint": policy_step,
+                },
+            )
+            last_checkpoint = policy_step
+
+    envs.close()
+    if cfg.algo.run_test and ctx.is_global_zero:
+        reward = test(agent, params, ctx, cfg, log_dir)
+        if logger is not None:
+            logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if logger is not None:
+        logger.close()

@@ -1,0 +1,336 @@
+"""DroQ (reference: ``/root/reference/sheeprl/algos/droq/droq.py``).
+
+SAC with Dropout+LayerNorm critics at a high replay ratio (arXiv:2110.02034).
+Reference semantics preserved: per minibatch, a shared TD target (min over EMA target
+critics − α·logp') trains every critic, each followed by its EMA update
+(``droq.py:95-122``); the actor trains on the MEAN of the Q-ensemble on a separate
+batch (``:124-130``).  The per-critic sequential gradient steps collapse into one joint
+step over the vmapped ensemble — the losses are parameter-disjoint, so the gradients are
+identical and the MXU sees one batched matmul instead of N small ones.  All G gradient
+steps of an iteration run in one ``lax.scan`` under jit."""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.ppo import make_optimizer
+from sheeprl_tpu.algos.sac.agent import SACActor
+from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.config.core import save_config
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.models.blocks import MLP
+from sheeprl_tpu.utils.env import make_vector_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio
+
+
+class DroQCriticEnsemble(nn.Module):
+    """Dropout+LayerNorm critic ensemble (reference ``droq/agent.py:20-60``),
+    vmapped over the ensemble axis."""
+
+    n_critics: int = 2
+    hidden_size: int = 256
+    dropout: float = 0.01
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        x = jnp.concatenate([obs, action], -1)
+
+        class _Critic(nn.Module):
+            hidden_size: int
+            dropout: float
+            dtype: Any
+
+            @nn.compact
+            def __call__(self, x, deterministic):
+                for _ in range(2):
+                    x = nn.Dense(self.hidden_size, dtype=self.dtype)(x)
+                    if self.dropout > 0:
+                        x = nn.Dropout(rate=self.dropout, deterministic=deterministic)(x)
+                    x = nn.LayerNorm(dtype=self.dtype)(x)
+                    x = nn.relu(x)
+                return nn.Dense(1, dtype=self.dtype)(x)
+
+        ensemble = nn.vmap(
+            _Critic,
+            in_axes=(None, None),
+            out_axes=0,
+            axis_size=self.n_critics,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )
+        return ensemble(self.hidden_size, self.dropout, self.dtype)(x, deterministic).astype(jnp.float32)
+
+
+@register_algorithm(name="droq")
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    import gymnasium
+
+    if not isinstance(act_space, gymnasium.spaces.Box):
+        raise ValueError("DroQ supports continuous (Box) action spaces only (reference parity)")
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    act_low, act_high = act_space.low, act_space.high
+    rescale = np.isfinite(act_low).all() and np.isfinite(act_high).all()
+    act_dim = int(np.prod(act_space.shape))
+    obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
+    target_entropy = -act_dim
+
+    actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size, dtype=ctx.compute_dtype)
+    critic = DroQCriticEnsemble(
+        n_critics=cfg.algo.critic.n,
+        hidden_size=cfg.algo.critic.hidden_size,
+        dropout=cfg.algo.critic.dropout,
+        dtype=ctx.compute_dtype,
+    )
+    dummy_obs, dummy_act = jnp.zeros((1, obs_dim)), jnp.zeros((1, act_dim))
+    params = {
+        "actor": actor.init(ctx.rng(), dummy_obs),
+        "critic": critic.init({"params": ctx.rng(), "dropout": ctx.rng()}, dummy_obs, dummy_act),
+        "log_alpha": jnp.asarray(jnp.log(cfg.algo.alpha.alpha), dtype=jnp.float32),
+    }
+    params["critic_target"] = jax.tree.map(lambda x: x, params["critic"])
+    params = ctx.replicate(params)
+
+    actor_opt = make_optimizer(cfg.algo.actor.optimizer, 0.0)
+    critic_opt = make_optimizer(cfg.algo.critic.optimizer, 0.0)
+    alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
+    opt_state = ctx.replicate(
+        {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        }
+    )
+
+    num_envs = cfg.env.num_envs
+    world = jax.process_count()
+    rb = ReplayBuffer(
+        max(int(cfg.buffer.size) // max(num_envs * world, 1), 1),
+        num_envs,
+        obs_keys=mlp_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+
+    tau, gamma, batch_size = cfg.algo.tau, cfg.algo.gamma, cfg.algo.per_rank_batch_size
+
+    @jax.jit
+    def act_fn(p, obs, key):
+        mean, log_std = actor.apply(p, obs)
+        return actor.dist(mean, log_std).sample(key)
+
+    @jax.jit
+    def train_critics_fn(p, o_state, batches, key):
+        """G scanned critic updates with per-minibatch shared targets + EMA."""
+
+        def step(carry, batch):
+            p, o_state = carry
+            k_next, k_drop = jax.random.split(batch.pop("_key"))
+            alpha = jnp.exp(p["log_alpha"])
+            next_mean, next_log_std = actor.apply(p["actor"], batch["next_obs"])
+            next_act, next_logp = actor.dist(next_mean, next_log_std).sample_and_log_prob(k_next)
+            next_logp = next_logp.sum(-1, keepdims=True)
+            q_next = critic.apply(p["critic_target"], batch["next_obs"], next_act, True).min(axis=0)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + (1 - batch["dones"]) * gamma * (q_next - alpha * next_logp)
+            )
+
+            def c_loss(cp):
+                qs = critic.apply(cp, batch["obs"], batch["actions"], False, rngs={"dropout": k_drop})
+                return ((qs - target[None]) ** 2).mean(axis=(1, 2)).sum()
+
+            cl, grads = jax.value_and_grad(c_loss)(p["critic"])
+            updates, new_c_state = critic_opt.update(grads, o_state["critic"], p["critic"])
+            p = {**p, "critic": optax.apply_updates(p["critic"], updates)}
+            p = {
+                **p,
+                "critic_target": jax.tree.map(lambda tp, cp: (1 - tau) * tp + tau * cp, p["critic_target"], p["critic"]),
+            }
+            return (p, {**o_state, "critic": new_c_state}), cl
+
+        g = batches["obs"].shape[0]
+        batches["_key"] = jax.random.split(key, g)
+        (p, o_state), closses = jax.lax.scan(step, (p, o_state), batches)
+        return p, o_state, closses.mean()
+
+    @jax.jit
+    def train_actor_fn(p, o_state, batch, key):
+        k_act, k_drop = jax.random.split(key)
+        alpha = jnp.exp(p["log_alpha"])
+
+        def a_loss(ap):
+            mean, log_std = actor.apply(ap, batch["obs"])
+            new_act, logp = actor.dist(mean, log_std).sample_and_log_prob(k_act)
+            logp = logp.sum(-1, keepdims=True)
+            # DroQ uses the ensemble MEAN, not the min (reference droq.py:126).
+            mean_q = critic.apply(p["critic"], batch["obs"], new_act, False, rngs={"dropout": k_drop}).mean(axis=0)
+            return actor_loss(alpha, logp, mean_q), logp
+
+        (al, logp), grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
+        updates, new_a_state = actor_opt.update(grads, o_state["actor"], p["actor"])
+        p = {**p, "actor": optax.apply_updates(p["actor"], updates)}
+
+        tl, t_grads = jax.value_and_grad(lambda la: alpha_loss(la, logp, target_entropy))(p["log_alpha"])
+        t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
+        p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
+        return p, {**o_state, "actor": new_a_state, "alpha": new_t_state}, al, tl
+
+    policy_steps_per_iter = num_envs * world
+    total_steps = int(cfg.algo.total_steps)
+    num_iters = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_iters = max(learning_starts - 1, 0)
+
+    start_iter, policy_step, last_log, last_checkpoint, cumulative_grad_steps = 1, 0, 0, 0, 0
+    if cfg.checkpoint.get("resume_from"):
+        state = CheckpointManager.load(
+            cfg.checkpoint.resume_from,
+            templates={"params": jax.device_get(params), "opt_state": jax.device_get(opt_state)},
+        )
+        params = ctx.replicate(state["params"])
+        opt_state = ctx.replicate(state["opt_state"])
+        ratio.load_state_dict(state["ratio"])
+        start_iter = state["iter_num"] + 1
+        policy_step = state["policy_step"]
+        last_log = state.get("last_log", 0)
+        last_checkpoint = state.get("last_checkpoint", 0)
+        cumulative_grad_steps = state.get("cumulative_grad_steps", 0)
+        learning_starts += start_iter
+        if cfg.buffer.checkpoint and "rb" in state:
+            rb.load_state_dict(state["rb"])
+
+    obs, _ = envs.reset(seed=cfg.seed + rank)
+    step_data: Dict[str, np.ndarray] = {}
+
+    for iter_num in range(start_iter, num_iters + 1):
+        env_t0 = time.perf_counter()
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = np.stack([act_space.sample() for _ in range(num_envs)])
+                tanh_actions = 2 * (actions - act_low) / (act_high - act_low) - 1 if rescale else actions
+            else:
+                obs_t = prepare_obs(obs, mlp_keys)
+                tanh_actions = np.asarray(jax.device_get(act_fn(params["actor"], obs_t, ctx.rng())))
+                actions = act_low + (tanh_actions + 1) * 0.5 * (act_high - act_low) if rescale else tanh_actions
+            next_obs, reward, terminated, truncated, info = envs.step(actions)
+            done = np.logical_or(terminated, truncated)
+            real_next = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
+            if done.any() and "final_obs" in info:
+                for i in np.nonzero(done)[0]:
+                    if info["final_obs"][i] is not None:
+                        for k in mlp_keys:
+                            real_next[k][i] = np.asarray(info["final_obs"][i][k])
+            for k in mlp_keys:
+                step_data[k] = np.asarray(obs[k])[None]
+                step_data[f"next_{k}"] = real_next[k][None]
+            step_data["actions"] = tanh_actions.astype(np.float32)[None]
+            step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
+            step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            obs = next_obs
+            policy_step += policy_steps_per_iter
+            record_episode_stats(aggregator, info)
+        env_time = time.perf_counter() - env_t0
+
+        train_time, grad_steps = 0.0, 0
+        if iter_num >= learning_starts:
+            grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
+            if grad_steps > 0:
+                sample = rb.sample(batch_size * grad_steps)
+                batches = {
+                    "obs": np.concatenate([sample[k].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1),
+                    "next_obs": np.concatenate(
+                        [sample[f"next_{k}"].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1
+                    ),
+                    "actions": sample["actions"].reshape(grad_steps, batch_size, -1),
+                    "rewards": sample["rewards"].reshape(grad_steps, batch_size, 1),
+                    "dones": sample["dones"].reshape(grad_steps, batch_size, 1),
+                }
+                batches = {k: jnp.asarray(v) for k, v in batches.items()}
+                actor_sample = rb.sample(batch_size)
+                actor_batch = {
+                    "obs": jnp.asarray(
+                        np.concatenate([actor_sample[k].reshape(batch_size, -1) for k in mlp_keys], -1)
+                    )
+                }
+                with timer("Time/train_time"):
+                    t0 = time.perf_counter()
+                    params, opt_state, c_loss_val = train_critics_fn(params, opt_state, batches, ctx.rng())
+                    params, opt_state, a_loss_val, t_loss_val = train_actor_fn(
+                        params, opt_state, actor_batch, ctx.rng()
+                    )
+                    train_time = time.perf_counter() - t0
+                cumulative_grad_steps += grad_steps
+                aggregator.update("Loss/value_loss", float(jax.device_get(c_loss_val)))
+                aggregator.update("Loss/policy_loss", float(jax.device_get(a_loss_val)))
+                aggregator.update("Loss/alpha_loss", float(jax.device_get(t_loss_val)))
+
+        if logger is not None and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
+        ):
+            metrics = aggregator.compute()
+            if train_time > 0:
+                metrics["Time/sps_train"] = grad_steps / train_time
+            metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
+            metrics["Params/replay_ratio"] = cumulative_grad_steps * world / policy_step if policy_step else 0.0
+            logger.log_metrics(metrics, policy_step)
+            aggregator.reset()
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or iter_num == num_iters
+            and cfg.checkpoint.save_last
+        ):
+            state = {
+                "params": params,
+                "opt_state": opt_state,
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": policy_step,
+                "cumulative_grad_steps": cumulative_grad_steps,
+            }
+            if cfg.buffer.checkpoint:
+                state["rb"] = rb.state_dict()
+            ckpt_manager.save(policy_step, state)
+            last_checkpoint = policy_step
+
+    envs.close()
+    if cfg.algo.run_test and ctx.is_global_zero:
+        reward = test(actor, params, ctx, cfg, log_dir)
+        if logger is not None:
+            logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if logger is not None:
+        logger.close()

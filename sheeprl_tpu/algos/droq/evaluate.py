@@ -1,0 +1,52 @@
+"""DroQ evaluation entry (reference: ``algos/droq/evaluate.py``).
+
+Rebuilds the DroQ-specific param tree (Dropout+LayerNorm critic ensemble) so the
+checkpoint template matches; evaluation itself only uses the actor."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.droq.droq import DroQCriticEnsemble
+from sheeprl_tpu.algos.sac.agent import SACActor
+from sheeprl_tpu.algos.sac.utils import test
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["droq"])
+def evaluate_droq(ctx, cfg: Dict[str, Any], ckpt_path: str) -> float:
+    log_dir = get_log_dir(cfg)
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    obs_space = env.observation_space
+    act_space = env.action_space
+    env.close()
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    act_dim = int(np.prod(act_space.shape))
+    obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
+
+    actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size, dtype=ctx.compute_dtype)
+    critic = DroQCriticEnsemble(
+        n_critics=cfg.algo.critic.n,
+        hidden_size=cfg.algo.critic.hidden_size,
+        dropout=cfg.algo.critic.dropout,
+        dtype=ctx.compute_dtype,
+    )
+    dummy_obs, dummy_act = jnp.zeros((1, obs_dim)), jnp.zeros((1, act_dim))
+    params = {
+        "actor": actor.init(ctx.rng(), dummy_obs),
+        "critic": critic.init({"params": ctx.rng(), "dropout": ctx.rng()}, dummy_obs, dummy_act),
+        "log_alpha": jnp.zeros(()),
+    }
+    params["critic_target"] = jax.tree.map(lambda x: x, params["critic"])
+    state = CheckpointManager.load(ckpt_path, templates={"params": jax.device_get(params)})
+    params = ctx.replicate(state["params"])
+    reward = test(actor, params, ctx, cfg, log_dir)
+    print(f"Test/cumulative_reward: {reward}")
+    return reward

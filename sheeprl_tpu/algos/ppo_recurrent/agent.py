@@ -1,0 +1,175 @@
+"""Recurrent PPO agent (reference: ``/root/reference/sheeprl/algos/ppo_recurrent/agent.py:83-…``).
+
+Encoder → (pre-RNN MLP) → LSTM → (post-RNN MLP) → actor/critic heads.  The LSTM input is
+the encoded observation concatenated with the previous action (reference ``:133-138``).
+
+TPU-native deviation (documented): instead of the reference's padded per-episode
+sequences with masks (``ppo_recurrent.py:39-118``), sequences are the fixed-shape
+``[rollout_steps, num_envs]`` rollout with hidden-state resets at episode starts applied
+*inside* the scan (``is_first`` masking, same trick as the RSSM).  The objective is the
+same; shapes are static so the whole update stays one jit."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import parse_action_space
+from sheeprl_tpu.models.blocks import MLP, MultiEncoder
+
+
+class RecurrentPPOAgent(nn.Module):
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    action_dims: Sequence[int]
+    is_continuous: bool
+    cnn_stacked: bool = False
+    cnn_features_dim: int = 512
+    mlp_features_dim: int = 64
+    dense_units: int = 64
+    mlp_layers: int = 1
+    dense_act: str = "tanh"
+    layer_norm: bool = False
+    lstm_hidden_size: int = 64
+    pre_rnn_mlp: bool = False
+    post_rnn_mlp: bool = False
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.feature_extractor = MultiEncoder(
+            cnn_keys=self.cnn_keys,
+            mlp_keys=self.mlp_keys,
+            cnn_stacked=self.cnn_stacked,
+            cnn_features_dim=self.cnn_features_dim,
+            mlp_hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            mlp_features_dim=self.mlp_features_dim,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+        )
+        if self.pre_rnn_mlp:
+            self.pre_mlp = MLP(
+                hidden_sizes=(self.dense_units,),
+                activation=self.dense_act,
+                layer_norm=self.layer_norm,
+                dtype=self.dtype,
+            )
+        self.cell = nn.OptimizedLSTMCell(self.lstm_hidden_size, dtype=self.dtype)
+        if self.post_rnn_mlp:
+            self.post_mlp = MLP(
+                hidden_sizes=(self.dense_units,),
+                activation=self.dense_act,
+                layer_norm=self.layer_norm,
+                dtype=self.dtype,
+            )
+        self.actor_backbone = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+        )
+        if self.is_continuous:
+            self.actor_heads = [nn.Dense(2 * self.action_dims[0], dtype=self.dtype)]
+        else:
+            self.actor_heads = [nn.Dense(d, dtype=self.dtype) for d in self.action_dims]
+        self.critic = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            output_dim=1,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+        )
+
+    def _heads(self, hidden: jax.Array) -> Tuple[List[jax.Array], jax.Array]:
+        feat = self.post_mlp(hidden) if self.post_rnn_mlp else hidden
+        pre_actor = self.actor_backbone(feat)
+        actor_out = [h(pre_actor).astype(jnp.float32) for h in self.actor_heads]
+        value = self.critic(feat).astype(jnp.float32)
+        return actor_out, value
+
+    def _rnn_input(self, obs: Dict[str, jax.Array], prev_actions: jax.Array) -> jax.Array:
+        feat = self.feature_extractor(obs)
+        x = jnp.concatenate([feat, prev_actions.astype(feat.dtype)], -1)
+        if self.pre_rnn_mlp:
+            x = self.pre_mlp(x)
+        return x
+
+    def step(
+        self,
+        obs: Dict[str, jax.Array],  # [B, ...]
+        prev_actions: jax.Array,  # [B, A]
+        is_first: jax.Array,  # [B, 1]
+        state: Tuple[jax.Array, jax.Array],
+    ):
+        """Single env-side step: returns (actor_out, value, new_state)."""
+        c, h = state
+        c = (1 - is_first) * c
+        h = (1 - is_first) * h
+        x = self._rnn_input(obs, (1 - is_first) * prev_actions)
+        (c, h), out = self.cell((c, h), x)
+        actor_out, value = self._heads(out.astype(jnp.float32))
+        return actor_out, value, (c, h)
+
+    def __call__(
+        self,
+        obs: Dict[str, jax.Array],  # [T, B, ...]
+        prev_actions: jax.Array,  # [T, B, A]
+        is_first: jax.Array,  # [T, B, 1]
+        initial_state: Tuple[jax.Array, jax.Array],  # ([B,H], [B,H])
+    ):
+        """Sequence forward with in-scan resets; returns (actor_out [T,B,...], values)."""
+        xs = self._rnn_input(obs, prev_actions * (1 - is_first))
+
+        def scan_step(carry, t):
+            c, h = carry
+            x, first = t
+            c = (1 - first) * c
+            h = (1 - first) * h
+            (c, h), out = self.cell((c, h), x)
+            return (c, h), out
+
+        _, outs = nn.scan(
+            lambda mdl, carry, t: scan_step(carry, t),
+            variable_broadcast="params",
+            split_rngs={"params": False},
+        )(self, initial_state, (xs, is_first))
+        actor_out, values = self._heads(outs.astype(jnp.float32))
+        return actor_out, values
+
+
+def build_agent(ctx, action_space, obs_space, cfg) -> Tuple[RecurrentPPOAgent, Any]:
+    is_continuous, dims = parse_action_space(action_space)
+    agent = RecurrentPPOAgent(
+        cnn_keys=list(cfg.algo.cnn_keys.encoder),
+        mlp_keys=list(cfg.algo.mlp_keys.encoder),
+        action_dims=dims,
+        is_continuous=is_continuous,
+        cnn_stacked=any(len(obs_space[k].shape) == 4 for k in cfg.algo.cnn_keys.encoder),
+        cnn_features_dim=cfg.algo.encoder.cnn_features_dim,
+        mlp_features_dim=cfg.algo.encoder.mlp_features_dim,
+        dense_units=cfg.algo.dense_units,
+        mlp_layers=cfg.algo.mlp_layers,
+        dense_act=cfg.algo.dense_act,
+        layer_norm=cfg.algo.layer_norm,
+        lstm_hidden_size=cfg.algo.rnn.lstm.hidden_size,
+        pre_rnn_mlp=cfg.algo.rnn.pre_rnn_mlp.apply,
+        post_rnn_mlp=cfg.algo.rnn.post_rnn_mlp.apply,
+        dtype=ctx.compute_dtype,
+    )
+    dummy_obs = {}
+    for k in list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder):
+        space = obs_space[k]
+        dummy_obs[k] = jnp.zeros((1, *space.shape), dtype=space.dtype)
+    act_sum = int(sum(dims))
+    h = cfg.algo.rnn.lstm.hidden_size
+    state0 = (jnp.zeros((1, h)), jnp.zeros((1, h)))
+    params = agent.init(
+        ctx.rng(), dummy_obs, jnp.zeros((1, act_sum)), jnp.ones((1, 1)), state0, method=RecurrentPPOAgent.step
+    )
+    params = ctx.replicate(params)
+    return agent, params

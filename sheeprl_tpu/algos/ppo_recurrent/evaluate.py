@@ -1,4 +1,4 @@
-"""SAC evaluation entry (reference: ``/root/reference/sheeprl/algos/sac/evaluate.py``)."""
+"""Recurrent PPO evaluation entry (reference: ``algos/ppo_recurrent/evaluate.py``)."""
 
 from __future__ import annotations
 
@@ -6,25 +6,24 @@ from typing import Any, Dict
 
 import jax
 
-from sheeprl_tpu.algos.sac.agent import build_agent
-from sheeprl_tpu.algos.sac.utils import test
+from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+from sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent import test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
-@register_evaluation(algorithms=["sac"])
-def evaluate_sac(ctx, cfg: Dict[str, Any], ckpt_path: str) -> float:
+@register_evaluation(algorithms=["ppo_recurrent"])
+def evaluate_ppo_recurrent(ctx, cfg: Dict[str, Any], ckpt_path: str) -> float:
     log_dir = get_log_dir(cfg)
     env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
     obs_space = env.observation_space
     act_space = env.action_space
     env.close()
-
-    actor, _, params = build_agent(ctx, act_space, obs_space, cfg)
+    agent, params = build_agent(ctx, act_space, obs_space, cfg)
     state = CheckpointManager.load(ckpt_path, templates={"params": jax.device_get(params)})
     params = ctx.replicate(state["params"])
-    reward = test(actor, params, ctx, cfg, log_dir)
+    reward = test(agent, params, ctx, cfg, log_dir)
     print(f"Test/cumulative_reward: {reward}")
     return reward

@@ -1,0 +1,333 @@
+"""Recurrent PPO training loop (reference: ``algos/ppo_recurrent/ppo_recurrent.py:120-…``).
+
+Rollout carries the LSTM state per env (reset at episode starts); the update runs BPTT
+over the fixed ``[rollout_steps, num_envs]`` sequences from the stored initial state,
+minibatching over the env/sequence axis — ``update_epochs`` × sequence-minibatches in
+one jitted ``lax.scan`` chain, like the feed-forward PPO."""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.ppo import make_optimizer
+from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, log_prob_and_entropy, prepare_obs, sample_actions
+from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, build_agent
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.config.core import save_config
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_vector_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay
+
+
+def _onehot_actions(env_act: np.ndarray, actions_dim, is_continuous: bool) -> np.ndarray:
+    if is_continuous:
+        return env_act.astype(np.float32)
+    n = env_act.shape[0]
+    out = []
+    acts = env_act.reshape(n, -1)
+    for i, d in enumerate(actions_dim):
+        oh = np.zeros((n, d), dtype=np.float32)
+        oh[np.arange(n), acts[:, i].astype(int)] = 1.0
+        out.append(oh)
+    return np.concatenate(out, -1)
+
+
+@register_algorithm(name="ppo_recurrent")
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    agent, params = build_agent(ctx, act_space, obs_space, cfg)
+    is_continuous = agent.is_continuous
+    actions_dim = agent.action_dims
+    act_sum = int(sum(actions_dim))
+    hidden = cfg.algo.rnn.lstm.hidden_size
+
+    opt = make_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    opt_state = ctx.replicate(opt.init(params))
+
+    num_envs = cfg.env.num_envs
+    rollout_steps = cfg.algo.rollout_steps
+    world = jax.process_count()
+    policy_steps_per_iter = int(num_envs * rollout_steps * world)
+    num_updates = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1) if not cfg.dry_run else 1
+    num_batches = max(int(cfg.algo.per_rank_num_batches), 1)
+    if num_envs % num_batches != 0:
+        raise ValueError(
+            f"env.num_envs ({num_envs}) must be divisible by algo.per_rank_num_batches "
+            f"({num_batches}): sequence minibatches must be equally sized for static shapes."
+        )
+    mb_envs = num_envs // num_batches
+
+    rb = ReplayBuffer(
+        rollout_steps,
+        num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+
+    gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
+
+    @jax.jit
+    def act_fn(p, obs, prev_actions, is_first, state, key):
+        actor_out, value, new_state = agent.apply(
+            p, obs, prev_actions, is_first, state, method=RecurrentPPOAgent.step
+        )
+        env_act, stored_act, logprob = sample_actions(key, actor_out, is_continuous)
+        return env_act, logprob, value[..., 0], new_state
+
+    gae_fn = jax.jit(lambda r, v, d, nv: gae(r, v, d, nv, rollout_steps, gamma, gae_lambda))
+
+    def seq_loss_fn(p, batch, clip_coef, ent_coef):
+        actor_out, values = agent.apply(
+            p,
+            {k: batch[k] for k in obs_keys},
+            batch["prev_actions"],
+            batch["is_first"],
+            (batch["c0"], batch["h0"]),
+        )
+        logprob, entropy = log_prob_and_entropy(actor_out, batch["actions"], is_continuous)
+        adv = batch["advantages"]
+        if cfg.algo.normalize_advantages:
+            adv = normalize_tensor(adv)
+        pg = policy_loss(logprob, batch["logprobs"], adv, clip_coef, "mean")
+        vf = value_loss(values[..., 0], batch["values"], batch["returns"], clip_coef, cfg.algo.clip_vloss, "mean")
+        ent = entropy_loss(entropy, cfg.algo.loss_reduction)
+        total = pg + cfg.algo.vf_coef * vf + cfg.algo.ent_coef * ent
+        return total, {"Loss/policy_loss": pg, "Loss/value_loss": vf, "Loss/entropy_loss": -ent}
+
+    @jax.jit
+    def train_fn(p, o_state, seq_data, c0, h0, key, clip_coef, ent_coef):
+        def mb_step(carry, env_idx):
+            p, o_state = carry
+            batch = jax.tree.map(lambda x: x[:, env_idx], seq_data)
+            batch["c0"] = c0[env_idx]
+            batch["h0"] = h0[env_idx]
+            (_, aux), grads = jax.value_and_grad(seq_loss_fn, has_aux=True)(p, batch, clip_coef, ent_coef)
+            updates, o_state = opt.update(grads, o_state, p)
+            return (optax.apply_updates(p, updates), o_state), aux
+
+        def epoch_step(carry, ekey):
+            perm = jax.random.permutation(ekey, num_envs).reshape(num_batches, mb_envs)
+            carry, auxs = jax.lax.scan(mb_step, carry, perm)
+            return carry, jax.tree.map(jnp.mean, auxs)
+
+        keys = jax.random.split(key, cfg.algo.update_epochs)
+        (p, o_state), metrics = jax.lax.scan(epoch_step, (p, o_state), keys)
+        return p, o_state, jax.tree.map(jnp.mean, metrics)
+
+    start_update, policy_step, last_log, last_checkpoint = 1, 0, 0, 0
+    if cfg.checkpoint.get("resume_from"):
+        state = CheckpointManager.load(
+            cfg.checkpoint.resume_from,
+            templates={"params": jax.device_get(params), "opt_state": jax.device_get(opt_state)},
+        )
+        params = ctx.replicate(state["params"])
+        opt_state = ctx.replicate(state["opt_state"])
+        start_update = state["update"] + 1
+        policy_step = state["policy_step"]
+        last_log = state.get("last_log", 0)
+        last_checkpoint = state.get("last_checkpoint", 0)
+
+    obs, _ = envs.reset(seed=cfg.seed + rank)
+    lstm_state = (jnp.zeros((num_envs, hidden)), jnp.zeros((num_envs, hidden)))
+    prev_stored = np.zeros((num_envs, act_sum), dtype=np.float32)
+    is_first_np = np.ones((num_envs, 1), dtype=np.float32)
+    step_data: Dict[str, np.ndarray] = {}
+
+    for update in range(start_update, num_updates + 1):
+        c0, h0 = lstm_state
+        env_t0 = time.perf_counter()
+        with timer("Time/env_interaction_time"):
+            for _ in range(rollout_steps):
+                obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
+                env_act, logprob, value, lstm_state = act_fn(
+                    params, obs_t, jnp.asarray(prev_stored), jnp.asarray(is_first_np), lstm_state, ctx.rng()
+                )
+                env_act_np = np.asarray(jax.device_get(env_act))
+                if is_continuous:
+                    low, high = act_space.low, act_space.high
+                    env_actions = np.clip(env_act_np, low, high) if np.isfinite(low).all() else env_act_np
+                elif len(actions_dim) == 1:
+                    env_actions = env_act_np[..., 0]
+                else:
+                    env_actions = env_act_np
+                next_obs, reward, terminated, truncated, info = envs.step(env_actions)
+                done = np.logical_or(terminated, truncated)
+                reward = np.asarray(reward, dtype=np.float32).reshape(num_envs)
+
+                # Bootstrap truncated episodes with V(final_obs) under the current
+                # recurrent state (reference ppo_recurrent.py:309-335).
+                if truncated.any() and "final_obs" in info:
+                    trunc_idx = np.nonzero(truncated)[0]
+                    final_obs = {
+                        k: np.stack([np.asarray(info["final_obs"][i][k]) for i in trunc_idx]) for k in obs_keys
+                    }
+                    sub_state = (lstm_state[0][trunc_idx], lstm_state[1][trunc_idx])
+                    _, _, v_final, _ = act_fn(
+                        params,
+                        prepare_obs(final_obs, cnn_keys, mlp_keys),
+                        jnp.asarray(prev_stored[trunc_idx]),
+                        jnp.zeros((len(trunc_idx), 1)),
+                        sub_state,
+                        ctx.rng(),
+                    )
+                    reward[trunc_idx] += gamma * np.asarray(jax.device_get(v_final))
+
+                for k in obs_keys:
+                    step_data[k] = np.asarray(obs[k])[None]
+                step_data["actions"] = env_act_np.reshape(num_envs, -1).astype(np.float32)[None]
+                step_data["prev_actions"] = prev_stored[None].copy()
+                step_data["is_first"] = is_first_np[None].copy()
+                step_data["logprobs"] = np.asarray(jax.device_get(logprob)).reshape(num_envs, 1)[None]
+                step_data["values"] = np.asarray(jax.device_get(value)).reshape(num_envs, 1)[None]
+                step_data["rewards"] = reward.reshape(num_envs, 1)[None]
+                step_data["dones"] = done.astype(np.float32).reshape(num_envs, 1)[None]
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                prev_stored = _onehot_actions(env_act_np, actions_dim, is_continuous)
+                prev_stored[done] = 0.0
+                is_first_np = done.astype(np.float32).reshape(num_envs, 1)
+                obs = next_obs
+                policy_step += num_envs * world
+                record_episode_stats(aggregator, info)
+        env_time = time.perf_counter() - env_t0
+
+        local = rb.to_tensor()
+        obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
+        _, _, next_value, _ = act_fn(
+            params, obs_t, jnp.asarray(prev_stored), jnp.asarray(is_first_np), lstm_state, ctx.rng()
+        )
+        returns, advantages = gae_fn(local["rewards"], local["values"], local["dones"], next_value[:, None])
+        seq_data = {
+            **{k: local[k] for k in obs_keys},
+            "actions": local["actions"],
+            "prev_actions": local["prev_actions"],
+            "is_first": local["is_first"],
+            "logprobs": local["logprobs"][..., 0],
+            "values": local["values"][..., 0],
+            "returns": returns[..., 0],
+            "advantages": advantages[..., 0],
+        }
+
+        clip_coef = cfg.algo.clip_coef
+        ent_coef = cfg.algo.ent_coef
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(update, initial=clip_coef, final=0.0, max_decay_steps=num_updates)
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(update, initial=ent_coef, final=0.0, max_decay_steps=num_updates)
+
+        with timer("Time/train_time"):
+            t0 = time.perf_counter()
+            params, opt_state, train_metrics = train_fn(
+                params, opt_state, seq_data, c0, h0, ctx.rng(), clip_coef, ent_coef
+            )
+            train_metrics = jax.device_get(train_metrics)
+            train_time = time.perf_counter() - t0
+        for k, v in train_metrics.items():
+            aggregator.update(k, float(v))
+
+        if logger is not None and (policy_step - last_log >= cfg.metric.log_every or update == num_updates or cfg.dry_run):
+            metrics = aggregator.compute()
+            metrics["Time/sps_train"] = (
+                cfg.algo.update_epochs * num_batches / train_time if train_time > 0 else 0.0
+            )
+            metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
+            logger.log_metrics(metrics, policy_step)
+            aggregator.reset()
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or update == num_updates
+            and cfg.checkpoint.save_last
+        ):
+            ckpt_manager.save(
+                policy_step,
+                {
+                    "params": params,
+                    "opt_state": opt_state,
+                    "update": update,
+                    "policy_step": policy_step,
+                    "last_log": last_log,
+                    "last_checkpoint": policy_step,
+                },
+            )
+            last_checkpoint = policy_step
+
+    envs.close()
+    if cfg.algo.run_test and ctx.is_global_zero:
+        reward = test(agent, params, ctx, cfg, log_dir)
+        if logger is not None:
+            logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if logger is not None:
+        logger.close()
+
+
+def test(agent, params, ctx, cfg, log_dir: str, greedy: bool = True) -> float:
+    """Greedy single-env evaluation with carried LSTM state."""
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    hidden = cfg.algo.rnn.lstm.hidden_size
+    act_sum = int(sum(agent.action_dims))
+
+    @jax.jit
+    def policy(p, obs, prev_actions, is_first, state, key):
+        actor_out, _, new_state = agent.apply(p, obs, prev_actions, is_first, state, method=RecurrentPPOAgent.step)
+        env_act, _, _ = sample_actions(key, actor_out, agent.is_continuous, greedy=greedy)
+        return env_act, new_state
+
+    obs, _ = env.reset(seed=cfg.seed)
+    state = (jnp.zeros((1, hidden)), jnp.zeros((1, hidden)))
+    prev = np.zeros((1, act_sum), dtype=np.float32)
+    is_first = np.ones((1, 1), dtype=np.float32)
+    done, cum_reward = False, 0.0
+    while not done:
+        obs_t = prepare_obs({k: np.asarray(v)[None] for k, v in obs.items()}, cnn_keys, mlp_keys)
+        act, state = policy(params, obs_t, jnp.asarray(prev), jnp.asarray(is_first), state, ctx.rng())
+        act_np = np.asarray(jax.device_get(act))
+        prev = _onehot_actions(act_np, agent.action_dims, agent.is_continuous)
+        is_first = np.zeros((1, 1), dtype=np.float32)
+        if agent.is_continuous:
+            env_action = act_np[0]
+        elif len(agent.action_dims) == 1:
+            env_action = int(act_np[0, 0])
+        else:
+            env_action = act_np[0]
+        obs, reward, terminated, truncated, _ = env.step(env_action)
+        done = bool(terminated or truncated)
+        cum_reward += float(reward)
+    env.close()
+    return cum_reward

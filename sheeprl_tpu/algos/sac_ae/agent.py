@@ -1,0 +1,152 @@
+"""SAC-AE agent (reference: ``/root/reference/sheeprl/algos/sac_ae/agent.py``).
+
+Pixel SAC with a convolutional autoencoder (Yarats et al., arXiv:1910.01741):
+
+* encoder: conv trunk → dense latent → LayerNorm → tanh (shared by the critics;
+  the actor uses stop-gradient features, reference ``sac_ae.py:80-84``);
+* decoder mirrors the encoder; trained with bit-depth-reduced MSE + an L2 latent
+  penalty (``sac_ae.py:100-115``);
+* EMA targets for both the encoder (tau 0.05) and the critics (tau 0.01).
+
+Convolutions run NHWC with SAME padding (exact halving/doubling) instead of the
+reference's VALID+output-padding arithmetic — architecturally equivalent, cleaner on
+the MXU."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import SACActor
+from sheeprl_tpu.models.blocks import MLP
+
+
+class AEEncoder(nn.Module):
+    latent_dim: int = 50
+    channels: int = 32
+    screen_size: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, detach: bool = False) -> jax.Array:
+        # x: [B, C, H, W] float in [0, 1] → NHWC
+        x = jnp.moveaxis(x, -3, -1).astype(self.dtype)
+        strides = (2, 1, 1, 1)
+        for s in strides:
+            x = nn.relu(nn.Conv(self.channels, (3, 3), strides=(s, s), padding="SAME", dtype=self.dtype)(x))
+        x = x.reshape(*x.shape[:-3], -1)
+        z = nn.Dense(self.latent_dim, dtype=self.dtype)(x)
+        z = nn.LayerNorm(dtype=self.dtype)(z)
+        z = jnp.tanh(z).astype(jnp.float32)
+        if detach:
+            z = jax.lax.stop_gradient(z)
+        return z
+
+
+class AEDecoder(nn.Module):
+    output_channels: int
+    latent_dim: int = 50
+    channels: int = 32
+    screen_size: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        h = self.screen_size // 2
+        x = nn.Dense(h * h * self.channels, dtype=self.dtype)(z.astype(self.dtype))
+        x = nn.relu(x)
+        lead = x.shape[:-1]
+        x = x.reshape(-1, h, h, self.channels)
+        for s in (1, 1, 1):
+            x = nn.relu(nn.ConvTranspose(self.channels, (3, 3), strides=(s, s), padding="SAME", dtype=self.dtype)(x))
+        x = nn.ConvTranspose(self.output_channels, (3, 3), strides=(2, 2), padding="SAME", dtype=self.dtype)(x)
+        x = jnp.moveaxis(x, -1, -3).astype(jnp.float32)  # back to [.., C, H, W]
+        return x.reshape(*lead, *x.shape[-3:])
+
+
+class AECriticEnsemble(nn.Module):
+    """Q heads over [latent, action] (the encoder is applied by the caller)."""
+
+    n_critics: int = 2
+    hidden_size: int = 1024
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([z, action], -1)
+        ensemble = nn.vmap(
+            MLP,
+            in_axes=None,
+            out_axes=0,
+            axis_size=self.n_critics,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )
+        return ensemble(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=1,
+            activation="relu",
+            dtype=self.dtype,
+        )(x).astype(jnp.float32)
+
+
+def preprocess_obs(obs: jax.Array, bits: int = 5) -> jax.Array:
+    """Bit-depth reduction (reference ``sac_ae/utils.py preprocess_obs``)."""
+    bins = 2**bits
+    obs = obs.astype(jnp.float32)
+    obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    obs = obs + jnp.zeros_like(obs)  # no dither (deterministic path)
+    return obs - 0.5
+
+
+def build_agent(
+    ctx,
+    action_space: gymnasium.spaces.Space,
+    obs_space: gymnasium.spaces.Dict,
+    cfg: Dict[str, Any],
+):
+    if not isinstance(action_space, gymnasium.spaces.Box):
+        raise ValueError("SAC-AE supports continuous (Box) action spaces only")
+    act_dim = int(np.prod(action_space.shape))
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    if not cnn_keys:
+        raise ValueError("SAC-AE requires at least one cnn key")
+    total_c = int(sum(np.prod(obs_space[k].shape[:-2]) for k in cnn_keys))
+
+    encoder = AEEncoder(
+        latent_dim=cfg.algo.encoder.features_dim,
+        channels=cfg.algo.encoder.channels,
+        screen_size=cfg.env.screen_size,
+        dtype=ctx.compute_dtype,
+    )
+    decoder = AEDecoder(
+        output_channels=total_c,
+        latent_dim=cfg.algo.encoder.features_dim,
+        channels=cfg.algo.encoder.channels,
+        screen_size=cfg.env.screen_size,
+        dtype=ctx.compute_dtype,
+    )
+    critic = AECriticEnsemble(
+        n_critics=cfg.algo.critic.n, hidden_size=cfg.algo.critic.dense_units, dtype=ctx.compute_dtype
+    )
+    actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.actor.dense_units, dtype=ctx.compute_dtype)
+
+    dummy_img = jnp.zeros((1, total_c, cfg.env.screen_size, cfg.env.screen_size))
+    enc_params = encoder.init(ctx.rng(), dummy_img)
+    z = encoder.apply(enc_params, dummy_img)
+    params = {
+        "encoder": enc_params,
+        "decoder": decoder.init(ctx.rng(), z),
+        "critic": critic.init(ctx.rng(), z, jnp.zeros((1, act_dim))),
+        "actor": actor.init(ctx.rng(), z),
+        "log_alpha": jnp.asarray(jnp.log(cfg.algo.alpha.alpha), dtype=jnp.float32),
+    }
+    params["target_encoder"] = jax.tree.map(lambda x: x, params["encoder"])
+    params["target_critic"] = jax.tree.map(lambda x: x, params["critic"])
+    return encoder, decoder, critic, actor, ctx.replicate(params)

@@ -1,0 +1,47 @@
+"""SAC-AE evaluation entry (reference: ``algos/sac_ae/evaluate.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.sac_ae import test
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["sac_ae"])
+def evaluate_sac_ae(ctx, cfg: Dict[str, Any], ckpt_path: str) -> float:
+    log_dir = get_log_dir(cfg)
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    obs_space = env.observation_space
+    act_space = env.action_space
+    env.close()
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+
+    encoder, decoder, critic, actor, params = build_agent(ctx, act_space, obs_space, cfg)
+    state = CheckpointManager.load(ckpt_path, templates={"params": jax.device_get(params)})
+    params = ctx.replicate(state["params"])
+
+    @jax.jit
+    def greedy_fn(p, img):
+        z = encoder.apply(p["encoder"], img)
+        mean, _ = actor.apply(p["actor"], z)
+        return jnp.tanh(mean)
+
+    def img_fn(o):
+        parts = []
+        for k in cnn_keys:
+            v = np.asarray(o[k])
+            parts.append(v.reshape(v.shape[0], -1, *v.shape[-2:]))
+        return np.concatenate(parts, axis=1).astype(np.float32)
+
+    reward = test(greedy_fn, params, ctx, cfg, log_dir, img_fn)
+    print(f"Test/cumulative_reward: {reward}")
+    return reward

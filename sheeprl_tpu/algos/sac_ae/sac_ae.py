@@ -1,0 +1,388 @@
+"""SAC-AE training loop (reference: ``/root/reference/sheeprl/algos/sac_ae/sac_ae.py``).
+
+Update cadence preserved from the reference (``sac_ae.py:62-115``): critic every step
+(gradients flow into the encoder), actor+α every ``actor.per_rank_update_freq`` steps on
+stop-gradient features, encoder+decoder reconstruction every
+``decoder.per_rank_update_freq`` steps, EMA targets (encoder AND critic) every
+``critic.per_rank_target_network_update_freq`` steps.  All G gradient steps of an
+iteration run in one ``lax.scan`` with the step counter in the carry driving the
+frequency conditionals."""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.ppo import make_optimizer
+from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
+from sheeprl_tpu.algos.sac_ae.agent import build_agent, preprocess_obs
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.config.core import save_config
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_vector_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+
+
+@register_algorithm(name="sac_ae")
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    act_low, act_high = act_space.low, act_space.high
+    rescale = np.isfinite(act_low).all() and np.isfinite(act_high).all()
+    act_dim = int(np.prod(act_space.shape))
+    target_entropy = -act_dim
+
+    encoder, decoder, critic, actor, params = build_agent(ctx, act_space, obs_space, cfg)
+
+    actor_opt = make_optimizer(cfg.algo.actor.optimizer, 0.0)
+    critic_opt = make_optimizer(cfg.algo.critic.optimizer, 0.0)  # covers encoder+critic
+    alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
+    enc_opt = make_optimizer(cfg.algo.encoder.optimizer, 0.0)
+    dec_opt = make_optimizer(cfg.algo.decoder.optimizer, 0.0)
+    opt_state = ctx.replicate(
+        {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init({"encoder": params["encoder"], "critic": params["critic"]}),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+            "encoder": enc_opt.init(params["encoder"]),
+            "decoder": dec_opt.init(params["decoder"]),
+        }
+    )
+
+    num_envs = cfg.env.num_envs
+    world = jax.process_count()
+    rb = ReplayBuffer(
+        max(int(cfg.buffer.size) // max(num_envs * world, 1), 1),
+        num_envs,
+        obs_keys=cnn_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+
+    gamma = cfg.algo.gamma
+    batch_size = cfg.algo.per_rank_batch_size
+    critic_tau = cfg.algo.critic.tau
+    encoder_tau = cfg.algo.encoder.tau
+    actor_freq = cfg.algo.actor.per_rank_update_freq
+    decoder_freq = cfg.algo.decoder.per_rank_update_freq
+    target_freq = cfg.algo.critic.per_rank_target_network_update_freq
+    l2_lambda = cfg.algo.decoder.l2_lambda
+
+    def _encode(enc_params, img, detach=False):
+        return encoder.apply(enc_params, img, detach)
+
+    @jax.jit
+    def act_fn(p, img, key):
+        z = _encode(p["encoder"], img)
+        mean, log_std = actor.apply(p["actor"], z)
+        return actor.dist(mean, log_std).sample(key)
+
+    @jax.jit
+    def greedy_fn(p, img):
+        z = _encode(p["encoder"], img)
+        mean, _ = actor.apply(p["actor"], z)
+        return jnp.tanh(mean)
+
+    @jax.jit
+    def train_fn(p, o_state, batches, key, step0):
+        def step(carry, batch):
+            p, o_state, gstep = carry
+            k_next, k_new, k_drop = jax.random.split(batch.pop("_key"), 3)
+            alpha = jnp.exp(p["log_alpha"])
+            obs = batch["obs"] / 255.0
+            next_obs = batch["next_obs"] / 255.0
+
+            # --- critic (encoder gradients flow)
+            z_next_t = _encode(p["target_encoder"], next_obs)
+            next_mean, next_log_std = actor.apply(p["actor"], z_next_t)
+            next_act, next_logp = actor.dist(next_mean, next_log_std).sample_and_log_prob(k_next)
+            next_logp = next_logp.sum(-1, keepdims=True)
+            q_next = critic.apply(p["target_critic"], z_next_t, next_act).min(axis=0)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + (1 - batch["dones"]) * gamma * (q_next - alpha * next_logp)
+            )
+
+            def c_loss(enc_crit):
+                z = _encode(enc_crit["encoder"], obs)
+                qs = critic.apply(enc_crit["critic"], z, batch["actions"])
+                return critic_loss(qs, target)
+
+            cl, c_grads = jax.value_and_grad(c_loss)({"encoder": p["encoder"], "critic": p["critic"]})
+            c_updates, new_c_state = critic_opt.update(
+                c_grads, o_state["critic"], {"encoder": p["encoder"], "critic": p["critic"]}
+            )
+            new_ec = optax.apply_updates({"encoder": p["encoder"], "critic": p["critic"]}, c_updates)
+            p = {**p, "encoder": new_ec["encoder"], "critic": new_ec["critic"]}
+            o_state = {**o_state, "critic": new_c_state}
+
+            # --- EMA targets
+            def do_targets(p):
+                return {
+                    **p,
+                    "target_critic": jax.tree.map(
+                        lambda tp, cp: (1 - critic_tau) * tp + critic_tau * cp, p["target_critic"], p["critic"]
+                    ),
+                    "target_encoder": jax.tree.map(
+                        lambda tp, cp: (1 - encoder_tau) * tp + encoder_tau * cp, p["target_encoder"], p["encoder"]
+                    ),
+                }
+
+            p = jax.lax.cond(gstep % target_freq == 0, do_targets, lambda p: p, p)
+
+            # --- actor + alpha (stop-gradient encoder features)
+            def do_actor(operand):
+                p, o_state = operand
+                z = jax.lax.stop_gradient(_encode(p["encoder"], obs))
+
+                def a_loss(ap):
+                    mean, log_std = actor.apply(ap, z)
+                    new_act, logp = actor.dist(mean, log_std).sample_and_log_prob(k_new)
+                    logp = logp.sum(-1, keepdims=True)
+                    min_q = critic.apply(p["critic"], z, new_act).min(axis=0)
+                    return actor_loss(jnp.exp(p["log_alpha"]), logp, min_q), logp
+
+                (al, logp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
+                a_updates, new_a_state = actor_opt.update(a_grads, o_state["actor"], p["actor"])
+                p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
+                tl, t_grads = jax.value_and_grad(lambda la: alpha_loss(la, logp, target_entropy))(p["log_alpha"])
+                t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
+                p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
+                return (p, {**o_state, "actor": new_a_state, "alpha": new_t_state}), al, tl
+
+            (p, o_state), al, tl = jax.lax.cond(
+                gstep % actor_freq == 0,
+                do_actor,
+                lambda operand: (operand, jnp.zeros(()), jnp.zeros(())),
+                (p, o_state),
+            )
+
+            # --- autoencoder
+            def do_decoder(operand):
+                p, o_state = operand
+
+                def r_loss(enc_dec):
+                    z = _encode(enc_dec["encoder"], obs)
+                    recon = decoder.apply(enc_dec["decoder"], z)
+                    target = preprocess_obs(batch["obs"], bits=5)
+                    mse = ((recon - target) ** 2).mean()
+                    l2 = (0.5 * (z**2).sum(-1)).mean()
+                    return mse + l2_lambda * l2
+
+                rl, grads = jax.value_and_grad(r_loss)({"encoder": p["encoder"], "decoder": p["decoder"]})
+                e_updates, new_e_state = enc_opt.update(grads["encoder"], o_state["encoder"], p["encoder"])
+                d_updates, new_d_state = dec_opt.update(grads["decoder"], o_state["decoder"], p["decoder"])
+                p = {
+                    **p,
+                    "encoder": optax.apply_updates(p["encoder"], e_updates),
+                    "decoder": optax.apply_updates(p["decoder"], d_updates),
+                }
+                return (p, {**o_state, "encoder": new_e_state, "decoder": new_d_state}), rl
+
+            (p, o_state), rl = jax.lax.cond(
+                gstep % decoder_freq == 0, do_decoder, lambda operand: (operand, jnp.zeros(())), (p, o_state)
+            )
+            metrics = {
+                "Loss/value_loss": cl,
+                "Loss/policy_loss": al,
+                "Loss/alpha_loss": tl,
+                "Loss/reconstruction_loss": rl,
+            }
+            return (p, o_state, gstep + 1), metrics
+
+        g = batches["obs"].shape[0]
+        batches["_key"] = jax.random.split(key, g)
+        (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, step0), batches)
+        return p, o_state, jax.tree.map(jnp.mean, metrics)
+
+    policy_steps_per_iter = num_envs * world
+    total_steps = int(cfg.algo.total_steps)
+    num_iters = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_iters = max(learning_starts - 1, 0)
+
+    start_iter, policy_step, last_log, last_checkpoint, cumulative_grad_steps = 1, 0, 0, 0, 0
+    if cfg.checkpoint.get("resume_from"):
+        state = CheckpointManager.load(
+            cfg.checkpoint.resume_from,
+            templates={"params": jax.device_get(params), "opt_state": jax.device_get(opt_state)},
+        )
+        params = ctx.replicate(state["params"])
+        opt_state = ctx.replicate(state["opt_state"])
+        ratio.load_state_dict(state["ratio"])
+        start_iter = state["iter_num"] + 1
+        policy_step = state["policy_step"]
+        last_log = state.get("last_log", 0)
+        last_checkpoint = state.get("last_checkpoint", 0)
+        cumulative_grad_steps = state.get("cumulative_grad_steps", 0)
+        learning_starts += start_iter
+        if cfg.buffer.checkpoint and "rb" in state:
+            rb.load_state_dict(state["rb"])
+
+    def _img(o, idxs=None):
+        parts = []
+        for k in cnn_keys:
+            v = np.asarray(o[k]) if idxs is None else np.asarray(o[k])[idxs]
+            parts.append(v.reshape(v.shape[0], -1, *v.shape[-2:]))
+        return np.concatenate(parts, axis=1).astype(np.float32)
+
+    obs, _ = envs.reset(seed=cfg.seed + rank)
+    step_data: Dict[str, np.ndarray] = {}
+
+    for iter_num in range(start_iter, num_iters + 1):
+        env_t0 = time.perf_counter()
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = np.stack([act_space.sample() for _ in range(num_envs)])
+                tanh_actions = 2 * (actions - act_low) / (act_high - act_low) - 1 if rescale else actions
+            else:
+                img = jnp.asarray(_img(obs) / 255.0)
+                tanh_actions = np.asarray(jax.device_get(act_fn(params, img, ctx.rng())))
+                actions = act_low + (tanh_actions + 1) * 0.5 * (act_high - act_low) if rescale else tanh_actions
+            next_obs, reward, terminated, truncated, info = envs.step(actions)
+            done = np.logical_or(terminated, truncated)
+            real_next = {k: np.asarray(next_obs[k]).copy() for k in cnn_keys}
+            if done.any() and "final_obs" in info:
+                for i in np.nonzero(done)[0]:
+                    if info["final_obs"][i] is not None:
+                        for k in cnn_keys:
+                            real_next[k][i] = np.asarray(info["final_obs"][i][k])
+            for k in cnn_keys:
+                v = np.asarray(obs[k])
+                step_data[k] = v.reshape(1, num_envs, -1, *v.shape[-2:])
+                nv = real_next[k]
+                step_data[f"next_{k}"] = nv.reshape(1, num_envs, -1, *nv.shape[-2:])
+            step_data["actions"] = tanh_actions.astype(np.float32)[None]
+            step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
+            step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            obs = next_obs
+            policy_step += policy_steps_per_iter
+            record_episode_stats(aggregator, info)
+        env_time = time.perf_counter() - env_t0
+
+        train_time, grad_steps = 0.0, 0
+        if iter_num >= learning_starts:
+            grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
+            if grad_steps > 0:
+                sample = rb.sample(batch_size * grad_steps)
+                g = grad_steps
+
+                def cat_imgs(prefix=""):
+                    return np.concatenate(
+                        [
+                            sample[f"{prefix}{k}"].reshape(g, batch_size, -1, *sample[f"{prefix}{k}"].shape[-2:])
+                            for k in cnn_keys
+                        ],
+                        axis=2,
+                    )
+
+                batches = {
+                    "obs": jnp.asarray(cat_imgs()),
+                    "next_obs": jnp.asarray(cat_imgs("next_")),
+                    "actions": jnp.asarray(sample["actions"].reshape(g, batch_size, -1)),
+                    "rewards": jnp.asarray(sample["rewards"].reshape(g, batch_size, 1)),
+                    "dones": jnp.asarray(sample["dones"].reshape(g, batch_size, 1)),
+                }
+                with timer("Time/train_time"):
+                    t0 = time.perf_counter()
+                    params, opt_state, train_metrics = train_fn(
+                        params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+                    )
+                    train_metrics = jax.device_get(train_metrics)
+                    train_time = time.perf_counter() - t0
+                cumulative_grad_steps += grad_steps
+                for k, v in train_metrics.items():
+                    aggregator.update(k, float(v))
+
+        if logger is not None and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
+        ):
+            metrics = aggregator.compute()
+            if train_time > 0:
+                metrics["Time/sps_train"] = grad_steps / train_time
+            metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
+            metrics["Params/replay_ratio"] = cumulative_grad_steps * world / policy_step if policy_step else 0.0
+            logger.log_metrics(metrics, policy_step)
+            aggregator.reset()
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or iter_num == num_iters
+            and cfg.checkpoint.save_last
+        ):
+            state = {
+                "params": params,
+                "opt_state": opt_state,
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": policy_step,
+                "cumulative_grad_steps": cumulative_grad_steps,
+            }
+            if cfg.buffer.checkpoint:
+                state["rb"] = rb.state_dict()
+            ckpt_manager.save(policy_step, state)
+            last_checkpoint = policy_step
+
+    envs.close()
+    if cfg.algo.run_test and ctx.is_global_zero:
+        reward = test(greedy_fn, params, ctx, cfg, log_dir, _img)
+        if logger is not None:
+            logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if logger is not None:
+        logger.close()
+
+
+def test(greedy_fn, params, ctx, cfg, log_dir: str, img_fn) -> float:
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    obs, _ = env.reset(seed=cfg.seed)
+    done, cum_reward = False, 0.0
+    while not done:
+        img = jnp.asarray(img_fn({k: np.asarray(v)[None] for k, v in obs.items()}) / 255.0)
+        act = np.asarray(jax.device_get(greedy_fn(params, img)))[0]
+        low, high = env.action_space.low, env.action_space.high
+        if np.isfinite(low).all() and np.isfinite(high).all():
+            act = low + (act + 1) * 0.5 * (high - low)
+        obs, reward, terminated, truncated, _ = env.step(act)
+        done = bool(terminated or truncated)
+        cum_reward += float(reward)
+    env.close()
+    return cum_reward

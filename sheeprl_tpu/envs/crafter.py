@@ -1,0 +1,47 @@
+"""Crafter adapter (reference: ``/root/reference/sheeprl/envs/crafter.py:17-66``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.utils.imports import _IS_CRAFTER_AVAILABLE
+
+if not _IS_CRAFTER_AVAILABLE:
+    raise ModuleNotFoundError("crafter is not installed: `pip install crafter`")
+
+import crafter  # noqa: E402
+
+
+class CrafterWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+
+    def __init__(self, id: str = "reward", screen_size: Tuple[int, int] | int = (64, 64), seed: Optional[int] = None):
+        if isinstance(screen_size, int):
+            screen_size = (screen_size, screen_size)
+        self._env = crafter.Env(size=screen_size, reward=(id == "reward"), seed=seed)
+        self.observation_space = gym.spaces.Dict(
+            {"rgb": gym.spaces.Box(0, 255, (3, *screen_size), np.uint8)}
+        )
+        self.action_space = gym.spaces.Discrete(self._env.action_space.n)
+        self.reward_range = (-np.inf, np.inf)
+
+    def _obs(self, obs: np.ndarray) -> Dict[str, np.ndarray]:
+        return {"rgb": np.transpose(obs, (2, 0, 1))}
+
+    def step(self, action):
+        obs, reward, done, info = self._env.step(int(action))
+        truncated = bool(info.get("discount", 1.0) != 0.0) and done
+        terminated = done and not truncated
+        return self._obs(obs), reward, terminated, truncated, info
+
+    def reset(self, seed=None, options=None):
+        return self._obs(self._env.reset()), {}
+
+    def render(self):
+        return self._env.render()
+
+    def close(self):
+        pass

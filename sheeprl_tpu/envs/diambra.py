@@ -1,0 +1,76 @@
+"""DIAMBRA arena adapter (reference: ``/root/reference/sheeprl/envs/diambra.py``).
+
+Fighting-game envs; observations flattened into a dict of {rgb, flat vector keys}
+(reference obs flattening ``diambra.py:123-128``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.utils.imports import _IS_DIAMBRA_AVAILABLE
+
+if not _IS_DIAMBRA_AVAILABLE:
+    raise ModuleNotFoundError("diambra is not installed: `pip install diambra diambra-arena`")
+
+import diambra.arena  # noqa: E402
+
+
+class DiambraWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+
+    def __init__(
+        self,
+        id: str,
+        rank: int = 0,
+        diambra_settings: Optional[Dict[str, Any]] = None,
+        diambra_wrappers: Optional[Dict[str, Any]] = None,
+        render_mode: str = "rgb_array",
+        log_level: int = 0,
+        increase_performance: bool = True,
+    ):
+        from diambra.arena import EnvironmentSettings, WrappersSettings
+
+        settings = EnvironmentSettings(**(diambra_settings or {}))
+        wrappers = WrappersSettings(**(diambra_wrappers or {}))
+        self._env = diambra.arena.make(id, settings, wrappers, render_mode=render_mode, rank=rank)
+        self.action_space = (
+            gym.spaces.MultiDiscrete(self._env.action_space.nvec)
+            if hasattr(self._env.action_space, "nvec")
+            else gym.spaces.Discrete(self._env.action_space.n)
+        )
+        spaces: Dict[str, gym.spaces.Space] = {}
+        for k, space in self._env.observation_space.spaces.items():
+            if isinstance(space, gym.spaces.Box) and len(space.shape) == 3:
+                h, w, c = space.shape
+                spaces[k] = gym.spaces.Box(0, 255, (c, h, w), np.uint8)
+            else:
+                dim = int(np.prod(space.shape)) if hasattr(space, "shape") and space.shape else 1
+                spaces[k] = gym.spaces.Box(-np.inf, np.inf, (dim,), np.float32)
+        self.observation_space = gym.spaces.Dict(spaces)
+
+    def _obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        out = {}
+        for k, v in obs.items():
+            v = np.asarray(v)
+            if v.ndim == 3:
+                out[k] = np.transpose(v, (2, 0, 1))
+            else:
+                out[k] = v.astype(np.float32).reshape(-1)
+        return out
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self._env.step(action)
+        return self._obs(obs), reward, terminated, truncated, info
+
+    def reset(self, seed=None, options=None):
+        obs, info = self._env.reset(seed=seed)
+        return self._obs(obs), info
+
+    def render(self):
+        return self._env.render()
+
+    def close(self):
+        self._env.close()

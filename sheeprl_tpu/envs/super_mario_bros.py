@@ -1,0 +1,49 @@
+"""Super Mario Bros adapter (reference: ``/root/reference/sheeprl/envs/super_mario_bros.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.utils.imports import _IS_SMB_AVAILABLE
+
+if not _IS_SMB_AVAILABLE:
+    raise ModuleNotFoundError("gym_super_mario_bros is not installed")
+
+import gym_super_mario_bros  # noqa: E402
+from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT  # noqa: E402
+from nes_py.wrappers import JoypadSpace  # noqa: E402
+
+ACTION_SPACES = {"right_only": RIGHT_ONLY, "simple": SIMPLE_MOVEMENT, "complex": COMPLEX_MOVEMENT}
+
+
+class SuperMarioBrosWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+
+    def __init__(self, id: str = "SuperMarioBros-v0", action_space: str = "simple", render_mode: str = "rgb_array"):
+        env = gym_super_mario_bros.make(id, render_mode=render_mode, apply_api_compatibility=True)
+        self._env = JoypadSpace(env, ACTION_SPACES[action_space])
+        obs_shape = self._env.observation_space.shape  # [H, W, C]
+        self.observation_space = gym.spaces.Dict(
+            {"rgb": gym.spaces.Box(0, 255, (obs_shape[2], obs_shape[0], obs_shape[1]), np.uint8)}
+        )
+        self.action_space = gym.spaces.Discrete(self._env.action_space.n)
+
+    def _obs(self, obs) -> Dict[str, np.ndarray]:
+        return {"rgb": np.transpose(np.asarray(obs), (2, 0, 1))}
+
+    def step(self, action):
+        obs, reward, done, truncated, info = self._env.step(int(action))
+        return self._obs(obs), reward, done, truncated, info
+
+    def reset(self, seed=None, options=None):
+        obs, info = self._env.reset()
+        return self._obs(obs), info
+
+    def render(self):
+        return self._env.render()
+
+    def close(self):
+        self._env.close()

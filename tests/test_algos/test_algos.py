@@ -124,3 +124,73 @@ def test_dreamer_v3_resume_and_evaluate(tmp_path):
         + standard_args(tmp_path, extra=["dry_run=False"])
     )
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+def test_a2c_dummy_env(tmp_path):
+    run(
+        [
+            "exp=a2c",
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+        ]
+        + standard_args(tmp_path)
+    )
+
+
+def test_droq_dummy_env(tmp_path):
+    run(
+        [
+            "exp=droq",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=8",
+            "algo.learning_starts=4",
+            "algo.total_steps=16",
+            "buffer.size=256",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_ppo_recurrent_dummy_env(tmp_path, env_id):
+    run(
+        [
+            "exp=ppo_recurrent",
+            f"env={env_id}",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=8",
+            "algo.per_rank_num_batches=2",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.rnn.lstm.hidden_size=8",
+            "algo.mlp_layers=1",
+        ]
+        + standard_args(tmp_path)
+    )
+
+
+def test_sac_ae_dummy_env(tmp_path):
+    run(
+        [
+            "exp=sac_ae",
+            "env=continuous_dummy",
+            "env.screen_size=32",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.encoder.features_dim=8",
+            "algo.encoder.channels=4",
+            "algo.actor.dense_units=8",
+            "algo.critic.dense_units=8",
+            "algo.per_rank_batch_size=4",
+            "algo.learning_starts=4",
+            "algo.total_steps=16",
+            "buffer.size=256",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
