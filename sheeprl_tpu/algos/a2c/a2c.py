@@ -168,6 +168,7 @@ def main(ctx, cfg) -> None:
             "advantages": advantages[..., 0],
         }
         data = jax.tree.map(lambda x: x.reshape(batch_n, *x.shape[2:]), data)
+        data = ctx.put_batch(data, batch_axis=0)
 
         with timer("Time/train_time"):
             t0 = time.perf_counter()

@@ -35,6 +35,7 @@ from sheeprl_tpu.distributions import (
     Normal,
     OneHotCategoricalStraightThrough,
     TanhNormal,
+    TruncatedNormal,
     unimix_logits,
 )
 from sheeprl_tpu.models.blocks import MLP, LayerNormGRUCell, _activation
@@ -470,6 +471,9 @@ class DreamerActor(nn.Module):
         dist_type = self.distribution
         if dist_type == "auto":
             dist_type = "scaled_normal" if self.is_continuous else "discrete"
+        supported = ("discrete",) if not self.is_continuous else ("tanh_normal", "normal", "trunc_normal", "scaled_normal")
+        if dist_type not in supported:
+            raise ValueError(f"distribution.type={dist_type!r} not supported for this action space; use one of {supported}")
         x = MLP(
             hidden_sizes=(self.dense_units,) * self.mlp_layers,
             activation="silu",
@@ -486,6 +490,9 @@ class DreamerActor(nn.Module):
                 dist = TanhNormal(mean, std)
             elif dist_type == "normal":
                 dist = Normal(mean, std)
+            elif dist_type == "trunc_normal":
+                std = 2 * jax.nn.sigmoid((std + self.init_std) / 2) + self.min_std
+                dist = TruncatedNormal(jnp.tanh(mean), std, -1.0, 1.0)
             else:  # scaled_normal
                 std = (self.max_std - self.min_std) * jax.nn.sigmoid(std + self.init_std) + self.min_std
                 dist = Normal(jnp.tanh(mean), std)

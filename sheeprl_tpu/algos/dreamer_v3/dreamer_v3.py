@@ -488,11 +488,19 @@ def main(ctx, cfg) -> None:
             if grad_steps > 0:
                 with timer("Time/train_time"):
                     t0 = time.perf_counter()
+                    # [n_samples, T, B, ...] with B sharded over the data axis: the
+                    # jitted step then runs data-parallel with GSPMD gradient psums
+                    # (falls back to replication when B doesn't divide the mesh).
                     sample = rb.sample_tensors(
                         batch_size,
                         sequence_length=seq_len,
                         n_samples=grad_steps,
                         dtype=None,
+                        sharding=(
+                            ctx.batch_sharding(2)
+                            if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
+                            else None
+                        ),
                     )
                     for g in range(grad_steps):
                         batch = {k: v[g] for k, v in sample.items()}

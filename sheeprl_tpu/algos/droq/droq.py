@@ -148,12 +148,14 @@ def main(ctx, cfg) -> None:
         mean, log_std = actor.apply(p, obs)
         return actor.dist(mean, log_std).sample(key)
 
+    target_update_freq = max(int(cfg.algo.critic.get("target_network_frequency", 1)), 1)
+
     @jax.jit
-    def train_critics_fn(p, o_state, batches, key):
+    def train_critics_fn(p, o_state, batches, key, grad_step0):
         """G scanned critic updates with per-minibatch shared targets + EMA."""
 
         def step(carry, batch):
-            p, o_state = carry
+            p, o_state, gstep = carry
             k_next, k_drop = jax.random.split(batch.pop("_key"))
             alpha = jnp.exp(p["log_alpha"])
             next_mean, next_log_std = actor.apply(p["actor"], batch["next_obs"])
@@ -171,15 +173,21 @@ def main(ctx, cfg) -> None:
             cl, grads = jax.value_and_grad(c_loss)(p["critic"])
             updates, new_c_state = critic_opt.update(grads, o_state["critic"], p["critic"])
             p = {**p, "critic": optax.apply_updates(p["critic"], updates)}
+            gstep = gstep + 1
+            do_update = (gstep % target_update_freq) == 0
             p = {
                 **p,
-                "critic_target": jax.tree.map(lambda tp, cp: (1 - tau) * tp + tau * cp, p["critic_target"], p["critic"]),
+                "critic_target": jax.tree.map(
+                    lambda tp, cp: jnp.where(do_update, (1 - tau) * tp + tau * cp, tp),
+                    p["critic_target"],
+                    p["critic"],
+                ),
             }
-            return (p, {**o_state, "critic": new_c_state}), cl
+            return (p, {**o_state, "critic": new_c_state}, gstep), cl
 
         g = batches["obs"].shape[0]
         batches["_key"] = jax.random.split(key, g)
-        (p, o_state), closses = jax.lax.scan(step, (p, o_state), batches)
+        (p, o_state, _), closses = jax.lax.scan(step, (p, o_state, grad_step0), batches)
         return p, o_state, closses.mean()
 
     @jax.jit
@@ -234,7 +242,8 @@ def main(ctx, cfg) -> None:
     for iter_num in range(start_iter, num_iters + 1):
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
-            if iter_num <= learning_starts:
+            # Don't replay the random prefill after resume (see sac.py).
+            if iter_num <= learning_starts and not cfg.checkpoint.get("resume_from"):
                 actions = np.stack([act_space.sample() for _ in range(num_envs)])
                 tanh_actions = 2 * (actions - act_low) / (act_high - act_low) - 1 if rescale else actions
             else:
@@ -275,16 +284,17 @@ def main(ctx, cfg) -> None:
                     "rewards": sample["rewards"].reshape(grad_steps, batch_size, 1),
                     "dones": sample["dones"].reshape(grad_steps, batch_size, 1),
                 }
-                batches = {k: jnp.asarray(v) for k, v in batches.items()}
+                batches = ctx.put_batch(batches, batch_axis=1)
                 actor_sample = rb.sample(batch_size)
-                actor_batch = {
-                    "obs": jnp.asarray(
-                        np.concatenate([actor_sample[k].reshape(batch_size, -1) for k in mlp_keys], -1)
-                    )
-                }
+                actor_batch = ctx.put_batch(
+                    {"obs": np.concatenate([actor_sample[k].reshape(batch_size, -1) for k in mlp_keys], -1)},
+                    batch_axis=0,
+                )
                 with timer("Time/train_time"):
                     t0 = time.perf_counter()
-                    params, opt_state, c_loss_val = train_critics_fn(params, opt_state, batches, ctx.rng())
+                    params, opt_state, c_loss_val = train_critics_fn(
+                        params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+                    )
                     params, opt_state, a_loss_val, t_loss_val = train_actor_fn(
                         params, opt_state, actor_batch, ctx.rng()
                     )

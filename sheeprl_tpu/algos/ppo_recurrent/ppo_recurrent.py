@@ -124,11 +124,18 @@ def main(ctx, cfg) -> None:
         total = pg + cfg.algo.vf_coef * vf + cfg.algo.ent_coef * ent
         return total, {"Loss/policy_loss": pg, "Loss/value_loss": vf, "Loss/entropy_loss": -ent}
 
+    # Shard each [T, mb_envs, ...] minibatch over the data axis (same pattern as
+    # ppo.py:134,171) so gradient computation is data-parallel under GSPMD.
+    dp_ok = ctx.data_parallel_size > 1 and mb_envs % ctx.data_parallel_size == 0
+    mb_sharding = ctx.sharding(None, "data")
+
     @jax.jit
     def train_fn(p, o_state, seq_data, c0, h0, key, clip_coef, ent_coef):
         def mb_step(carry, env_idx):
             p, o_state = carry
             batch = jax.tree.map(lambda x: x[:, env_idx], seq_data)
+            if dp_ok:
+                batch = jax.tree.map(lambda x: jax.lax.with_sharding_constraint(x, mb_sharding), batch)
             batch["c0"] = c0[env_idx]
             batch["h0"] = h0[env_idx]
             (_, aux), grads = jax.value_and_grad(seq_loss_fn, has_aux=True)(p, batch, clip_coef, ent_coef)

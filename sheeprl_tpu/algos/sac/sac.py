@@ -115,12 +115,13 @@ def main(ctx, cfg) -> None:
             qs = critic.apply(cp, obs, action)
             return critic_loss(qs, target)
 
-        # --- actor (reference sac.py:50-58)
-        def a_loss(ap):
+        # --- actor (reference sac.py:50-58); takes the critic params explicitly so the
+        # caller can pass the POST-update critic (reference updates critic first).
+        def a_loss(ap, critic_params):
             mean, log_std = actor.apply(ap, obs)
             new_act, logp = actor.dist(mean, log_std).sample_and_log_prob(key_new)
             logp = logp.sum(-1, keepdims=True)
-            min_q = critic.apply(p["critic"], obs, new_act).min(axis=0)
+            min_q = critic.apply(critic_params, obs, new_act).min(axis=0)
             return actor_loss(alpha, logp, min_q), logp
 
         # --- alpha (reference sac.py:61-79)
@@ -129,17 +130,20 @@ def main(ctx, cfg) -> None:
 
         return c_loss, a_loss, t_loss
 
+    target_update_freq = max(int(cfg.algo.critic.get("target_network_frequency", 1)), 1)
+
     @jax.jit
-    def train_fn(p, o_state, batches, key):
+    def train_fn(p, o_state, batches, key, grad_step0):
         def step(carry, batch):
-            p, o_state = carry
+            p, o_state, gstep = carry
             c_loss, a_loss, t_loss = _losses(p, batch, batch.pop("_key"))
 
             cl, c_grads = jax.value_and_grad(c_loss)(p["critic"])
             c_updates, new_c_state = critic_opt.update(c_grads, o_state["critic"], p["critic"])
             p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
 
-            (al, logp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
+            # Actor minimises against the freshly-updated critic (reference sac.py:49-63).
+            (al, logp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"], p["critic"])
             a_updates, new_a_state = actor_opt.update(a_grads, o_state["actor"], p["actor"])
             p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
 
@@ -147,19 +151,24 @@ def main(ctx, cfg) -> None:
             t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
             p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
 
-            # Fused EMA target update (reference agent.py:265).
+            # EMA target update, gated on critic.target_network_frequency (reference
+            # sac.py:349-355 gates on the update counter; freq=1 ⇒ every step).
+            gstep = gstep + 1
+            do_update = (gstep % target_update_freq) == 0
             p = {
                 **p,
                 "critic_target": jax.tree.map(
-                    lambda tp, cp: (1 - tau) * tp + tau * cp, p["critic_target"], p["critic"]
+                    lambda tp, cp: jnp.where(do_update, (1 - tau) * tp + tau * cp, tp),
+                    p["critic_target"],
+                    p["critic"],
                 ),
             }
             o_state = {"actor": new_a_state, "critic": new_c_state, "alpha": new_t_state}
-            return (p, o_state), {"Loss/value_loss": cl, "Loss/policy_loss": al, "Loss/alpha_loss": tl}
+            return (p, o_state, gstep), {"Loss/value_loss": cl, "Loss/policy_loss": al, "Loss/alpha_loss": tl}
 
         g = batches["obs"].shape[0]
         batches["_key"] = jax.random.split(key, g)
-        (p, o_state), metrics = jax.lax.scan(step, (p, o_state), batches)
+        (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, grad_step0), batches)
         return p, o_state, jax.tree.map(jnp.mean, metrics)
 
     # ------------------------------------------------------------------ counters
@@ -197,7 +206,9 @@ def main(ctx, cfg) -> None:
     for iter_num in range(start_iter, num_iters + 1):
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
-            if iter_num <= learning_starts:
+            # A resumed run already has a trained policy — don't replay the random
+            # prefill (reference resume branch; dreamer_v3.py has the same guard).
+            if iter_num <= learning_starts and not cfg.checkpoint.get("resume_from"):
                 actions = np.stack([act_space.sample() for _ in range(num_envs)])
                 tanh_actions = (
                     2 * (actions - act_low) / (act_high - act_low) - 1 if rescale else actions
@@ -254,10 +265,14 @@ def main(ctx, cfg) -> None:
                     "rewards": sample["rewards"].reshape(grad_steps, batch_size, 1),
                     "dones": sample["dones"].reshape(grad_steps, batch_size, 1),
                 }
-                batches = {k: jnp.asarray(v) for k, v in batches.items()}
+                # Batch axis 1 of the [G, B, ...] block sharded over the data axis —
+                # GSPMD inserts the gradient all-reduce (params stay replicated).
+                batches = ctx.put_batch(batches, batch_axis=1)
                 with timer("Time/train_time"):
                     t0 = time.perf_counter()
-                    params, opt_state, train_metrics = train_fn(params, opt_state, batches, ctx.rng())
+                    params, opt_state, train_metrics = train_fn(
+                        params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+                    )
                     train_metrics = jax.device_get(train_metrics)
                     train_time = time.perf_counter() - t0
                 cumulative_grad_steps += grad_steps

@@ -309,13 +309,16 @@ def main(ctx, cfg) -> None:
                         axis=2,
                     )
 
-                batches = {
-                    "obs": jnp.asarray(cat_imgs()),
-                    "next_obs": jnp.asarray(cat_imgs("next_")),
-                    "actions": jnp.asarray(sample["actions"].reshape(g, batch_size, -1)),
-                    "rewards": jnp.asarray(sample["rewards"].reshape(g, batch_size, 1)),
-                    "dones": jnp.asarray(sample["dones"].reshape(g, batch_size, 1)),
-                }
+                batches = ctx.put_batch(
+                    {
+                        "obs": cat_imgs(),
+                        "next_obs": cat_imgs("next_"),
+                        "actions": sample["actions"].reshape(g, batch_size, -1),
+                        "rewards": sample["rewards"].reshape(g, batch_size, 1),
+                        "dones": sample["dones"].reshape(g, batch_size, 1),
+                    },
+                    batch_axis=1,
+                )
                 with timer("Time/train_time"):
                     t0 = time.perf_counter()
                     params, opt_state, train_metrics = train_fn(

@@ -138,6 +138,10 @@ class TanhNormal(Distribution):
     def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
         return jnp.tanh(self.base.sample(key, sample_shape))
 
+    def rsample(self, key: jax.Array) -> jax.Array:
+        # Reparameterised: tanh of the Normal's pathwise sample.
+        return jnp.tanh(self.base.rsample(key))
+
     def log_prob(self, a: jax.Array) -> jax.Array:
         a = jnp.clip(a, -1 + self.eps, 1 - self.eps)
         pre = jnp.arctanh(a)
@@ -151,6 +155,14 @@ class TanhNormal(Distribution):
     @property
     def mean(self) -> jax.Array:
         return jnp.tanh(self.base.loc)
+
+    def entropy(self) -> jax.Array:
+        # H[tanh(X)] = H[X] + E[log|dtanh/dx|]; the expectation of the log-det has no
+        # closed form, so approximate it at the mean (delta method) — the reference
+        # falls back to a sampled estimate (torch TransformedDistribution has none).
+        loc = self.base.loc
+        log_det = 2.0 * (math.log(2.0) - loc - jax.nn.softplus(-2.0 * loc))
+        return self.base.entropy() + log_det
 
 
 class TruncatedNormal(Distribution):
@@ -199,7 +211,15 @@ class TruncatedNormal(Distribution):
         return self.mode
 
     def entropy(self) -> jax.Array:
-        return Normal(self.loc, self.scale).entropy()
+        # Exact truncated-normal entropy (reference distribution.py:64-132):
+        # H = log(sqrt(2*pi*e)*scale*Z) + (a*pdf(a) - b*pdf(b)) / (2Z)
+        a = (self.low - self.loc) / self.scale
+        b = (self.high - self.loc) / self.scale
+        phi_a = jax.scipy.stats.norm.pdf(a)
+        phi_b = jax.scipy.stats.norm.pdf(b)
+        z = jax.scipy.stats.norm.cdf(b) - jax.scipy.stats.norm.cdf(a)
+        z = jnp.maximum(z, 1e-8)
+        return 0.5 + _HALF_LOG_2PI + jnp.log(self.scale) + jnp.log(z) + (a * phi_a - b * phi_b) / (2 * z)
 
 
 class Categorical(Distribution):

@@ -115,9 +115,24 @@ class MeshContext:
         spec = [None] * batch_axis + ["data"]
         return self.sharding(*spec)
 
-    def shard_batch(self, tree: Any, batch_axis: int = 0) -> Any:
+    def put_batch(self, tree: Any, batch_axis: int = 0) -> Any:
+        """Host→device transfer with the batch axis sharded over ``data``.
+
+        This is what makes every training loop actually data-parallel (the reference
+        gets this implicitly from DDP's per-process batches).  Falls back to
+        replication per-leaf when the batch axis doesn't divide the mesh — e.g. tiny
+        dry-run batches on the 8-device CI mesh — so loops never crash on shape edge
+        cases.
+        """
+        dp = self.data_parallel_size
         sh = self.batch_sharding(batch_axis)
-        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+        rep = self.replicated
+
+        def _put(x):
+            divisible = x.ndim > batch_axis and x.shape[batch_axis] % dp == 0
+            return jax.device_put(x, sh if (dp > 1 and divisible) else rep)
+
+        return jax.tree.map(_put, tree)
 
     def replicate(self, tree: Any) -> Any:
         return jax.device_put(tree, self.replicated)
