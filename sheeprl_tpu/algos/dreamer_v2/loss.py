@@ -1,0 +1,68 @@
+"""DreamerV2 world-model loss (reference: ``/root/reference/sheeprl/algos/dreamer_v2/loss.py``).
+
+KL balancing (Eq. 2 of the DV2 paper, reference ``loss.py:60-79``): the KL between the
+posterior and prior categorical latents is computed twice — once with the posterior
+stopped (training the prior toward the posterior, weight ``alpha``) and once with the
+prior stopped (regularizing the posterior, weight ``1 - alpha``) — each clipped below by
+``kl_free_nats``.  ``kl_free_avg`` selects whether the free-nats clip is applied to the
+batch mean (reference default) or per-element before averaging.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def categorical_kl(post_logits: jax.Array, prior_logits: jax.Array) -> jax.Array:
+    """KL( Cat(post) || Cat(prior) ) summed over the stochastic dimension.
+
+    Inputs are ``[..., stoch, discrete]`` logits; output is ``[...]``.
+    """
+    post_logp = jax.nn.log_softmax(post_logits, -1)
+    prior_logp = jax.nn.log_softmax(prior_logits, -1)
+    post_p = jnp.exp(post_logp)
+    return jnp.sum(post_p * (post_logp - prior_logp), axis=(-2, -1))
+
+
+def reconstruction_loss(
+    observation_lp: jax.Array,  # [T, B] summed log-prob of all decoded obs
+    reward_lp: jax.Array,  # [T, B]
+    prior_logits: jax.Array,  # [T, B, stoch, discrete]
+    posterior_logits: jax.Array,  # [T, B, stoch, discrete]
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 0.0,
+    kl_free_avg: bool = True,
+    kl_regularizer: float = 1.0,
+    continue_lp: Optional[jax.Array] = None,
+    discount_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    observation_loss = -observation_lp.mean()
+    reward_loss = -reward_lp.mean()
+    sg = jax.lax.stop_gradient
+    lhs = categorical_kl(sg(posterior_logits), prior_logits)
+    rhs = categorical_kl(posterior_logits, sg(prior_logits))
+    kl = lhs
+    if kl_free_avg:
+        loss_lhs = jnp.maximum(lhs.mean(), kl_free_nats)
+        loss_rhs = jnp.maximum(rhs.mean(), kl_free_nats)
+    else:
+        loss_lhs = jnp.maximum(lhs, kl_free_nats).mean()
+        loss_rhs = jnp.maximum(rhs, kl_free_nats).mean()
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+    if continue_lp is not None:
+        continue_loss = discount_scale_factor * -continue_lp.mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    total = kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss
+    metrics = {
+        "Loss/world_model_loss": total,
+        "Loss/observation_loss": observation_loss,
+        "Loss/reward_loss": reward_loss,
+        "Loss/state_loss": kl_loss,
+        "Loss/continue_loss": continue_loss,
+        "State/kl": kl.mean(),
+    }
+    return total, metrics

@@ -1,0 +1,46 @@
+"""DreamerV2 helpers (reference: ``/root/reference/sheeprl/algos/dreamer_v2/utils.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401  (shared host-side helpers)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,  # [H, N, 1]
+    values: jax.Array,  # [H, N, 1]
+    continues: jax.Array,  # [H, N, 1] (already γ-scaled)
+    bootstrap: jax.Array,  # [1, N, 1]
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(λ) targets over an imagined trajectory (reference ``utils.py:121-141``):
+    ``λ[i] = r[i] + c[i]·((1-λ)·V[i+1] + λ·λ[i+1])`` with ``λ[H] = V[H]`` (bootstrap),
+    computed as a reverse ``lax.scan``."""
+    next_values = jnp.concatenate([values[1:], bootstrap], 0)
+    inputs = rewards + continues * next_values * (1 - lmbda)
+
+    def step(agg, x):
+        inp, cont = x
+        agg = inp + cont * lmbda * agg
+        return agg, agg
+
+    _, lv = jax.lax.scan(step, bootstrap[0], (inputs, continues), reverse=True)
+    return lv

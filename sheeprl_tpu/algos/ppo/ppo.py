@@ -51,6 +51,11 @@ def make_optimizer(opt_cfg: Dict[str, Any], max_grad_norm: float, lr_schedule=No
     name = opt_cfg.get("name", "adam")
     if name == "adam":
         opt = optax.adam(lr, eps=opt_cfg.get("eps", 1e-8), b1=opt_cfg.get("betas", [0.9, 0.999])[0])
+        wd = opt_cfg.get("weight_decay", 0.0)
+        if wd:
+            # torch.optim.Adam weight_decay is L2-into-gradient, i.e. the decay is
+            # added BEFORE the Adam scaling (unlike decoupled AdamW).
+            opt = optax.chain(optax.add_decayed_weights(wd), opt)
     elif name == "adamw":
         opt = optax.adamw(lr, eps=opt_cfg.get("eps", 1e-8), weight_decay=opt_cfg.get("weight_decay", 0.0))
     elif name == "sgd":

@@ -194,3 +194,39 @@ def test_sac_ae_dummy_env(tmp_path):
         ]
         + standard_args(tmp_path, extra=["dry_run=False"])
     )
+
+
+DV2_ARGS = [
+    "exp=dreamer_v2_dummy",
+    "algo.total_steps=32",
+    "algo.learning_starts=16",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_dreamer_v2_dummy_envs(tmp_path, env_id):
+    run(DV2_ARGS + [f"env={env_id}"] + standard_args(tmp_path, extra=["dry_run=False"]))
+
+
+def test_dreamer_v2_episode_buffer(tmp_path):
+    run(
+        DV2_ARGS
+        # dummy episodes are 6 steps long; the EpisodeBuffer refuses episodes shorter
+        # than the sample sequence length (reference buffers.py:986)
+        + ["env=discrete_dummy", "buffer.type=episode", "buffer.prioritize_ends=True", "algo.per_rank_sequence_length=5"]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+
+
+def test_dreamer_v2_resume_and_evaluate(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(DV2_ARGS + ["env=discrete_dummy"] + standard_args(tmp_path, extra=["dry_run=False"]))
+    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    assert ckpts
+    run(
+        DV2_ARGS
+        + ["env=discrete_dummy", f"checkpoint.resume_from={ckpts[-1]}", "algo.total_steps=48"]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
