@@ -1,0 +1,342 @@
+"""DreamerV1 agent modules (reference: ``/root/reference/sheeprl/algos/dreamer_v1/agent.py``).
+
+DV1 shares the DV2 encoder/decoder/actor/critic (the reference imports them,
+``dreamer_v1/agent.py:16-27``); what is specific to DV1:
+
+* **continuous Gaussian stochastic state** (size 30, no discrete categoricals):
+  representation/transition MLPs emit ``2·stoch`` (mean, std) with
+  ``std = softplus(std) + min_std`` (reference ``dreamer_v1/utils.py:80-108``);
+* a plain GRU recurrent model — Dense+ELU into a standard (non-LayerNorm,
+  no update-bias) GRU cell (reference ``agent.py:31-61``);
+* **no ``is_first`` masking** in ``dynamic`` (reference ``agent.py:97-134``) — state
+  resets happen only on the player side via ``init_states``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    ActorV2,
+    CNNDecoderV2,
+    CriticV2,
+    EncoderV2,
+    MLPDecoderV2,
+    _xavier_normal_init,
+    add_exploration_noise,
+    exploration_amount,
+)
+from sheeprl_tpu.algos.dreamer_v3.agent import PlayerState, parse_actions_dim  # noqa: F401
+from sheeprl_tpu.models.blocks import MLP
+
+Dtype = Any
+
+
+def compute_stochastic_state(
+    key: Optional[jax.Array], state_information: jax.Array, min_std: float = 0.1
+) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+    """(mean, std) split + reparameterised Gaussian sample (reference
+    ``dreamer_v1/utils.py:80-108``)."""
+    mean, std = jnp.split(state_information, 2, -1)
+    std = jax.nn.softplus(std) + min_std
+    if key is None:
+        return (mean, std), mean
+    sample = mean + std * jax.random.normal(key, mean.shape)
+    return (mean, std), sample
+
+
+class RecurrentModelV1(nn.Module):
+    """Dense+act → plain GRU cell (reference ``agent.py:31-61``)."""
+
+    recurrent_state_size: int
+    activation: str = "elu"
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.mlp = MLP(
+            hidden_sizes=(self.recurrent_state_size,),
+            activation=self.activation,
+            layer_norm=False,
+            dtype=self.dtype,
+            name="input_proj",
+        )
+        self.rnn = nn.GRUCell(features=self.recurrent_state_size, dtype=self.dtype)
+
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = self.mlp(x)
+        h, _ = self.rnn(recurrent_state.astype(self.dtype), feat)
+        return h.astype(jnp.float32)
+
+
+class RSSMV1(nn.Module):
+    """Continuous-Gaussian RSSM (reference ``agent.py:64-189``)."""
+
+    stochastic_size: int = 30
+    recurrent_state_size: int = 200
+    transition_hidden_size: int = 200
+    representation_hidden_size: int = 200
+    min_std: float = 0.1
+    activation: str = "elu"
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.recurrent_model = RecurrentModelV1(
+            recurrent_state_size=self.recurrent_state_size, activation=self.activation, dtype=self.dtype
+        )
+        self.representation_model = MLP(
+            hidden_sizes=(self.representation_hidden_size,),
+            output_dim=self.stochastic_size * 2,
+            activation=self.activation,
+            dtype=self.dtype,
+        )
+        self.transition_model = MLP(
+            hidden_sizes=(self.transition_hidden_size,),
+            output_dim=self.stochastic_size * 2,
+            activation=self.activation,
+            dtype=self.dtype,
+        )
+
+    def _representation(self, recurrent_state: jax.Array, embedded_obs: jax.Array, key: Optional[jax.Array]):
+        out = self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1)).astype(jnp.float32)
+        return compute_stochastic_state(key, out, self.min_std)
+
+    def _transition(self, recurrent_state: jax.Array, key: Optional[jax.Array]):
+        out = self.transition_model(recurrent_state).astype(jnp.float32)
+        return compute_stochastic_state(key, out, self.min_std)
+
+    def dynamic(self, posterior: jax.Array, recurrent_state: jax.Array, action: jax.Array, embedded_obs: jax.Array, key: jax.Array):
+        """One posterior step — NO ``is_first`` reset, per DV1 (reference ``agent.py:97-134``)."""
+        k1, k2 = jax.random.split(key)
+        recurrent_state = self.recurrent_model(jnp.concatenate([posterior, action], -1), recurrent_state)
+        prior_mean_std, prior = self._transition(recurrent_state, k1)
+        posterior_mean_std, posterior_sample = self._representation(recurrent_state, embedded_obs, k2)
+        return recurrent_state, posterior_sample, prior, posterior_mean_std, prior_mean_std
+
+    def imagination(self, stochastic_state: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key: jax.Array):
+        recurrent_state = self.recurrent_model(jnp.concatenate([stochastic_state, actions], -1), recurrent_state)
+        _, imagined = self._transition(recurrent_state, key)
+        return imagined, recurrent_state
+
+
+class WorldModelV1(nn.Module):
+    """Encoder + Gaussian RSSM + decoders + reward (+ optional continue) heads."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_shapes: Dict[str, Tuple[int, ...]]
+    mlp_shapes: Dict[str, Tuple[int, ...]]
+    cnn_channels_multiplier: int = 32
+    dense_units: int = 400
+    mlp_layers: int = 4
+    stochastic_size: int = 30
+    recurrent_state_size: int = 200
+    transition_hidden_size: int = 200
+    representation_hidden_size: int = 200
+    min_std: float = 0.1
+    dense_act: str = "elu"
+    cnn_act: str = "relu"
+    use_continues: bool = False
+    image_size: int = 64
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.encoder = EncoderV2(
+            cnn_keys=self.cnn_keys,
+            mlp_keys=self.mlp_keys,
+            cnn_channels_multiplier=self.cnn_channels_multiplier,
+            dense_units=self.dense_units,
+            mlp_layers=self.mlp_layers,
+            activation=self.dense_act,
+            layer_norm=False,
+            dtype=self.dtype,
+        )
+        self.rssm = RSSMV1(
+            stochastic_size=self.stochastic_size,
+            recurrent_state_size=self.recurrent_state_size,
+            transition_hidden_size=self.transition_hidden_size,
+            representation_hidden_size=self.representation_hidden_size,
+            min_std=self.min_std,
+            activation=self.dense_act,
+            dtype=self.dtype,
+        )
+        if self.cnn_keys:
+            final = (self.image_size - 4) // 2 + 1
+            for _ in range(3):
+                final = (final - 4) // 2 + 1
+            self.observation_model_cnn = CNNDecoderV2(
+                output_shapes=self.cnn_shapes,
+                cnn_encoder_output_dim=final * final * self.cnn_channels_multiplier * 8,
+                channels_multiplier=self.cnn_channels_multiplier,
+                activation=self.cnn_act,
+                layer_norm=False,
+                dtype=self.dtype,
+            )
+        if self.mlp_keys:
+            self.observation_model_mlp = MLPDecoderV2(
+                output_shapes=self.mlp_shapes,
+                dense_units=self.dense_units,
+                mlp_layers=self.mlp_layers,
+                activation=self.dense_act,
+                layer_norm=False,
+                dtype=self.dtype,
+            )
+        self.reward_model = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            output_dim=1,
+            activation=self.dense_act,
+            dtype=self.dtype,
+        )
+        if self.use_continues:
+            self.continue_model = MLP(
+                hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                output_dim=1,
+                activation=self.dense_act,
+                dtype=self.dtype,
+            )
+
+    def encode(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        return self.encoder(obs)
+
+    def decode(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_keys:
+            out.update(self.observation_model_cnn(latent))
+        if self.mlp_keys:
+            out.update(self.observation_model_mlp(latent))
+        return out
+
+    def reward(self, latent: jax.Array) -> jax.Array:
+        return self.reward_model(latent).astype(jnp.float32)
+
+    def continues(self, latent: jax.Array) -> jax.Array:
+        return self.continue_model(latent).astype(jnp.float32)
+
+    def dynamic(self, *args, **kwargs):
+        return self.rssm.dynamic(*args, **kwargs)
+
+    def imagination(self, *args, **kwargs):
+        return self.rssm.imagination(*args, **kwargs)
+
+    def representation(self, recurrent_state, embedded_obs, key):
+        return self.rssm._representation(recurrent_state, embedded_obs, key)
+
+    def __call__(self, obs: Dict[str, jax.Array], action: jax.Array, key: jax.Array):
+        embed = self.encoder(obs)
+        batch_shape = embed.shape[:-1]
+        h0 = jnp.zeros((*batch_shape, self.recurrent_state_size))
+        z0 = jnp.zeros((*batch_shape, self.stochastic_size))
+        h, z, prior, post_ms, prior_ms = self.rssm.dynamic(z0, h0, action, embed, key)
+        latent = jnp.concatenate([z, h], -1)
+        recon = self.decode(latent)
+        out = self.reward(latent)
+        if self.use_continues:
+            out = out + 0.0 * self.continues(latent)
+        return out, recon
+
+
+def build_agent(
+    ctx,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+):
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_shapes = {k: tuple(obs_space[k].shape) for k in cnn_keys}
+    mlp_shapes = {k: tuple(obs_space[k].shape) for k in mlp_keys}
+    wm_cfg = cfg.algo.world_model
+
+    world_model = WorldModelV1(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        cnn_shapes=cnn_shapes,
+        mlp_shapes=mlp_shapes,
+        cnn_channels_multiplier=wm_cfg.encoder.cnn_channels_multiplier,
+        dense_units=cfg.algo.dense_units,
+        mlp_layers=cfg.algo.mlp_layers,
+        stochastic_size=wm_cfg.stochastic_size,
+        recurrent_state_size=wm_cfg.recurrent_model.recurrent_state_size,
+        transition_hidden_size=wm_cfg.transition_model.hidden_size,
+        representation_hidden_size=wm_cfg.representation_model.hidden_size,
+        min_std=wm_cfg.min_std,
+        dense_act=cfg.algo.dense_act,
+        cnn_act=cfg.algo.cnn_act,
+        use_continues=wm_cfg.use_continues,
+        image_size=cfg.env.screen_size,
+        dtype=ctx.compute_dtype,
+    )
+    latent_size = wm_cfg.stochastic_size + wm_cfg.recurrent_model.recurrent_state_size
+    actor = ActorV2(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.get("type", "auto"),
+        dense_units=cfg.algo.actor.dense_units,
+        mlp_layers=cfg.algo.actor.mlp_layers,
+        activation=cfg.algo.dense_act,
+        layer_norm=False,
+        init_std=cfg.algo.actor.init_std,
+        min_std=cfg.algo.actor.min_std,
+        dtype=ctx.compute_dtype,
+    )
+    critic = CriticV2(
+        dense_units=cfg.algo.critic.dense_units,
+        mlp_layers=cfg.algo.critic.mlp_layers,
+        activation=cfg.algo.dense_act,
+        layer_norm=False,
+        dtype=ctx.compute_dtype,
+    )
+
+    dummy_obs = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((1, *cnn_shapes[k]), dtype=jnp.uint8)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, *mlp_shapes[k]), dtype=jnp.float32)
+    act_dim_sum = int(sum(actions_dim))
+    wm_params = world_model.init(ctx.rng(), dummy_obs, jnp.zeros((1, act_dim_sum)), ctx.rng())
+    actor_params = actor.init(ctx.rng(), jnp.zeros((1, latent_size)), ctx.rng())
+    critic_params = critic.init(ctx.rng(), jnp.zeros((1, latent_size)))
+
+    wm_params = {"params": _xavier_normal_init(wm_params["params"], ctx.rng())}
+    actor_params = {"params": _xavier_normal_init(actor_params["params"], ctx.rng())}
+    critic_params = {"params": _xavier_normal_init(critic_params["params"], ctx.rng())}
+
+    params = {
+        "world_model": ctx.replicate(wm_params),
+        "actor": ctx.replicate(actor_params),
+        "critic": ctx.replicate(critic_params),
+    }
+    return world_model, actor, critic, params, latent_size
+
+
+def make_player_step(world_model: WorldModelV1, actor: ActorV2, actions_dim: Sequence[int], is_continuous: bool):
+    """Pure player step (reference ``PlayerDV1``, ``agent.py:219-326``): zero resets on
+    ``is_first`` (the functional analogue of ``init_states``), optional exploration noise."""
+
+    def player_step(params, state: PlayerState, obs, is_first, key, expl_amount=0.0, greedy: bool = False):
+        k_repr, k_act, k_expl = jax.random.split(key, 3)
+        wm, ap = params["world_model"], params["actor"]
+        embed = world_model.apply(wm, obs, method=WorldModelV1.encode)
+        recurrent = (1 - is_first) * state.recurrent_state
+        stoch = (1 - is_first) * state.stochastic_state
+        prev_actions = (1 - is_first) * state.actions
+        recurrent = world_model.apply(
+            wm,
+            jnp.concatenate([stoch, prev_actions], -1),
+            recurrent,
+            method=lambda m, x, h: m.rssm.recurrent_model(x, h),
+        )
+        _, stoch = world_model.apply(wm, recurrent, embed, k_repr, method=WorldModelV1.representation)
+        latent = jnp.concatenate([stoch, recurrent], -1)
+        actions, _ = actor.apply(ap, latent, k_act, greedy)
+        if not greedy:
+            actions = add_exploration_noise(actions, jnp.asarray(expl_amount), k_expl, is_continuous)
+        stored = jnp.concatenate(actions, -1)
+        return actions, stored, PlayerState(recurrent, stoch, stored)
+
+    return player_step

@@ -1,0 +1,54 @@
+"""DreamerV1 losses (reference: ``/root/reference/sheeprl/algos/dreamer_v1/loss.py``).
+
+ELBO with a Normal-KL state loss clipped below by free nats (Eq. 10 of the PlaNet/DV1
+papers, reference ``loss.py:41-95``): ``state_loss = max(KL(post || prior).mean(),
+free_nats)``.  No KL balancing (that arrives in DV2).
+
+Note: the reference's continue term (``loss.py:91``) reads ``+ qc.log_prob(targets)``
+without negation — a sign slip that is dormant because ``use_continues`` defaults to
+False for DV1; this implementation uses the correct negative log-likelihood.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def normal_kl(post_mean, post_std, prior_mean, prior_std) -> jax.Array:
+    """KL( N(post) || N(prior) ) summed over the stochastic dimension."""
+    var_ratio = (post_std / prior_std) ** 2
+    t1 = ((post_mean - prior_mean) / prior_std) ** 2
+    return 0.5 * jnp.sum(var_ratio + t1 - 1.0 - jnp.log(var_ratio), axis=-1)
+
+
+def reconstruction_loss(
+    observation_lp: jax.Array,  # [T, B]
+    reward_lp: jax.Array,  # [T, B]
+    posterior_mean_std: Tuple[jax.Array, jax.Array],
+    prior_mean_std: Tuple[jax.Array, jax.Array],
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    continue_lp: Optional[jax.Array] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    observation_loss = -observation_lp.mean()
+    reward_loss = -reward_lp.mean()
+    kl = normal_kl(*posterior_mean_std, *prior_mean_std).mean()
+    state_loss = jnp.maximum(kl, kl_free_nats)
+    if continue_lp is not None:
+        continue_loss = continue_scale_factor * -continue_lp.mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    total = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    metrics = {
+        "Loss/world_model_loss": total,
+        "Loss/observation_loss": observation_loss,
+        "Loss/reward_loss": reward_loss,
+        "Loss/state_loss": state_loss,
+        "Loss/continue_loss": continue_loss,
+        "State/kl": kl,
+    }
+    return total, metrics

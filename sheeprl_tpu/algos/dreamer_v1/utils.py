@@ -1,0 +1,47 @@
+"""DreamerV1 helpers (reference: ``/root/reference/sheeprl/algos/dreamer_v1/utils.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Params/exploration_amount",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,  # [H, N, 1] rewards at imagined states 0..H-1
+    values: jax.Array,  # [H, N, 1]
+    continues: jax.Array,  # [H, N, 1] (γ-scaled)
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """DV1 λ-targets (reference ``dreamer_v1/utils.py:42-78``): ``H-1`` targets where
+    ``λ[i] = r[i] + c[i]·(1-λ)·V[i+1] + λ·c[i]·λ[i+1]`` for ``i < H-2`` and the last
+    entry bootstraps the full value: ``λ[H-2] = r[H-2] + c[H-2]·V[H-1]``."""
+    horizon = rewards.shape[0]
+    next_vals = jnp.concatenate([values[1 : horizon - 1] * (1 - lmbda), values[horizon - 1 : horizon]], 0)
+    inputs = rewards[: horizon - 1] + continues[: horizon - 1] * next_vals
+
+    def step(agg, x):
+        inp, cont = x
+        agg = inp + cont * lmbda * agg
+        return agg, agg
+
+    _, lv = jax.lax.scan(step, jnp.zeros_like(values[0]), (inputs, continues[: horizon - 1]), reverse=True)
+    return lv

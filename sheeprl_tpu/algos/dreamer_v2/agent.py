@@ -30,7 +30,6 @@ import numpy as np
 
 from sheeprl_tpu.algos.dreamer_v3.agent import PlayerState, parse_actions_dim
 from sheeprl_tpu.distributions import (
-    Independent,
     Normal,
     OneHotCategorical,
     OneHotCategoricalStraightThrough,
@@ -235,6 +234,7 @@ class RSSMV2(nn.Module):
     representation_hidden_size: int = 600
     activation: str = "elu"
     layer_norm: bool = False
+    recurrent_layer_norm: bool = True
     dtype: Dtype = jnp.float32
 
     def setup(self):
@@ -243,7 +243,7 @@ class RSSMV2(nn.Module):
             recurrent_state_size=self.recurrent_state_size,
             dense_units=self.dense_units,
             activation=self.activation,
-            layer_norm=True,
+            layer_norm=self.recurrent_layer_norm,
             dtype=self.dtype,
         )
         self.representation_model = nn.Sequential(
@@ -321,6 +321,7 @@ class WorldModelV2(nn.Module):
     representation_hidden_size: int = 600
     activation: str = "elu"
     layer_norm: bool = False
+    recurrent_layer_norm: bool = True
     use_continues: bool = False
     image_size: int = 64
     dtype: Dtype = jnp.float32
@@ -345,6 +346,7 @@ class WorldModelV2(nn.Module):
             representation_hidden_size=self.representation_hidden_size,
             activation=self.activation,
             layer_norm=self.layer_norm,
+            recurrent_layer_norm=self.recurrent_layer_norm,
             dtype=self.dtype,
         )
         if self.cnn_keys:
@@ -551,8 +553,11 @@ def _xavier_normal_init(params: Dict[str, Any], key: jax.Array) -> Dict[str, Any
     for i, (path, value) in enumerate(flat.items()):
         leaf = str(path[-1])
         if leaf == "kernel" and value.ndim >= 2:
-            fan_in = int(np.prod(value.shape[:-1]))
-            fan_out = int(value.shape[-1])
+            # torch.nn.init.xavier_normal_ counts the conv receptive field in BOTH
+            # fans (kernel layout here is [*rf, in, out]).
+            receptive_field = int(np.prod(value.shape[:-2])) if value.ndim > 2 else 1
+            fan_in = receptive_field * int(value.shape[-2])
+            fan_out = receptive_field * int(value.shape[-1])
             std = float(np.sqrt(2.0 / (fan_in + fan_out)))
             new[path] = std * jax.random.normal(keys[i], value.shape, value.dtype)
         elif leaf == "bias":
@@ -591,6 +596,7 @@ def build_agent(
         representation_hidden_size=wm_cfg.representation_model.hidden_size,
         activation=cfg.algo.dense_act,
         layer_norm=cfg.algo.layer_norm,
+        recurrent_layer_norm=wm_cfg.recurrent_model.get("layer_norm", True),
         use_continues=wm_cfg.use_continues,
         image_size=cfg.env.screen_size,
         dtype=ctx.compute_dtype,

@@ -230,3 +230,29 @@ def test_dreamer_v2_resume_and_evaluate(tmp_path):
         + standard_args(tmp_path, extra=["dry_run=False"])
     )
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+DV1_ARGS = [
+    "exp=dreamer_v1_dummy",
+    "algo.total_steps=32",
+    "algo.learning_starts=16",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_dreamer_v1_dummy_envs(tmp_path, env_id):
+    run(DV1_ARGS + [f"env={env_id}"] + standard_args(tmp_path, extra=["dry_run=False"]))
+
+
+def test_dreamer_v1_resume_and_evaluate(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(DV1_ARGS + ["env=discrete_dummy"] + standard_args(tmp_path, extra=["dry_run=False"]))
+    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    assert ckpts
+    run(
+        DV1_ARGS
+        + ["env=discrete_dummy", f"checkpoint.resume_from={ckpts[-1]}", "algo.total_steps=48"]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
