@@ -1,0 +1,129 @@
+"""Plan2Explore shared machinery (reference: ``/root/reference/sheeprl/algos/p2e_dv{1,2,3}``).
+
+The reference builds its disagreement ensemble as a python list of N independent MLPs
+iterated one-by-one (``p2e_dv3/agent.py:175-204``, ``p2e_dv3_exploration.py:208-230``).
+TPU-native version: ONE MLP definition with N **stacked** parameter pytrees driven by
+``jax.vmap`` — every ensemble member's matmul fuses into a single batched MXU op, for
+both the training loss and the intrinsic-reward variance, instead of N small kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.models.blocks import MLP
+
+
+def build_ensembles(
+    rng_key: jax.Array,
+    n: int,
+    input_dim: int,
+    output_dim: int,
+    dense_units: int,
+    mlp_layers: int,
+    activation: str,
+    layer_norm: bool,
+    dtype: Any,
+) -> Tuple[MLP, Any]:
+    """N ensemble members as one module + stacked params (reference seeds each member
+    differently, ``p2e_dv3/agent.py:178-199``; here each member gets its own PRNG key)."""
+    mlp = MLP(
+        hidden_sizes=(dense_units,) * mlp_layers,
+        output_dim=output_dim,
+        activation=activation,
+        layer_norm=layer_norm,
+        dtype=dtype,
+    )
+    keys = jax.random.split(rng_key, n)
+    dummy = jnp.zeros((1, input_dim))
+    stacked = jax.vmap(lambda k: mlp.init(k, dummy))(keys)
+    return mlp, stacked
+
+
+def ensemble_apply(mlp: MLP, stacked_params: Any, x: jax.Array) -> jax.Array:
+    """[N, ...] predictions from all members in one vmapped (batched-matmul) pass."""
+    return jax.vmap(lambda p: mlp.apply(p, x))(stacked_params)
+
+
+def ensemble_loss(mlp: MLP, stacked_params: Any, inputs: jax.Array, targets: jax.Array) -> jax.Array:
+    """Sum over members of the per-member MSE 'log-prob' loss (reference
+    ``p2e_dv3_exploration.py:206-221``: ``-MSEDistribution(out[:-1], 1).log_prob(next)``)."""
+    preds = ensemble_apply(mlp, stacked_params, inputs)[:, :-1]  # [N, T-1, B, D]
+    sq = jnp.sum((preds - targets[None]) ** 2, -1)  # MSEDistribution dims=1 log_prob = -Σ(err²)
+    return jnp.mean(sq, axis=(1, 2)).sum()
+
+
+def intrinsic_reward(
+    mlp: MLP, stacked_params: Any, inputs: jax.Array, multiplier: float
+) -> jax.Array:
+    """Ensemble-disagreement intrinsic reward (reference ``p2e_dv3_exploration.py:270-287``):
+    variance across members of the predicted next-state embedding, mean over features."""
+    preds = ensemble_apply(mlp, stacked_params, jax.lax.stop_gradient(inputs))  # [N, H+1, TB, D]
+    return preds.var(0).mean(-1, keepdims=True) * multiplier
+
+
+def load_exploration_config(cfg) -> Any:
+    """Load + validate the exploration run's config for finetuning
+    (reference ``cli.py:117-148``)."""
+    from pathlib import Path
+
+    from sheeprl_tpu.config.core import load_config
+
+    ckpt_path = Path(cfg.checkpoint.exploration_ckpt_path)
+    run_dir = ckpt_path.parent.parent if ckpt_path.is_dir() else ckpt_path.parent
+    cfg_path = run_dir / "config.yaml"
+    if not cfg_path.is_file():
+        cfg_path = ckpt_path.parent / "config.yaml"
+    if not cfg_path.is_file():
+        raise FileNotFoundError(f"No config.yaml found alongside exploration checkpoint {ckpt_path}")
+    exploration_cfg = load_config(cfg_path)
+    if exploration_cfg.env.id != cfg.env.id:
+        raise ValueError(
+            "This experiment is run with a different environment from the one of the "
+            f"exploration you want to finetune. Got '{cfg.env.id}', but the environment "
+            f"used during exploration was {exploration_cfg.env.id}."
+        )
+    # Environment geometry must match the exploration world model.
+    for key in (
+        "frame_stack",
+        "screen_size",
+        "action_repeat",
+        "grayscale",
+        "clip_rewards",
+        "frame_stack_dilation",
+        "max_episode_steps",
+        "reward_as_observation",
+    ):
+        if key in exploration_cfg.env:
+            cfg.env[key] = exploration_cfg.env[key]
+    # The finetuned models must be built exactly like the exploration ones, or the
+    # checkpoint cannot be loaded (reference p2e_dv3_finetuning.py:46-69).
+    for key in (
+        "gamma",
+        "lmbda",
+        "horizon",
+        "layer_norm",
+        "dense_units",
+        "mlp_layers",
+        "dense_act",
+        "cnn_act",
+        "unimix",
+        "hafner_initialization",
+        "world_model",
+        "actor",
+        "critic",
+        "critics_exploration",
+        "ensembles",
+        "cnn_keys",
+        "mlp_keys",
+        "intrinsic_reward_multiplier",
+    ):
+        if key in exploration_cfg.algo:
+            cfg.algo[key] = exploration_cfg.algo[key]
+    # Reusing the exploration buffer requires the same env count (see reference note).
+    if cfg.buffer.get("load_from_exploration") and exploration_cfg.buffer.checkpoint:
+        cfg.env.num_envs = exploration_cfg.env.num_envs
+    return exploration_cfg

@@ -1,0 +1,92 @@
+"""P2E-DV3 agent builder (reference: ``/root/reference/sheeprl/algos/p2e_dv3/agent.py``).
+
+Extends the DreamerV3 agent with:
+
+* an **exploration actor** (same ``DreamerActor`` class as the task actor);
+* a dict of **exploration critics** — each entry carries a weight and a reward type
+  (``intrinsic`` = ensemble disagreement, ``task`` = learned reward model), with its own
+  EMA target critic (reference ``agent.py:118-156``);
+* a **disagreement ensemble** predicting the next stochastic state from
+  ``(latent, action)`` — vmapped stacked params, see ``sheeprl_tpu/algos/p2e``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    DreamerActor,
+    DreamerCritic,
+    PlayerState,  # noqa: F401
+    apply_hafner_init,
+    build_agent as dv3_build_agent,
+    make_player_step,  # noqa: F401
+    parse_actions_dim,  # noqa: F401
+    zero_init_head,
+)
+from sheeprl_tpu.algos.p2e import build_ensembles
+
+
+def build_agent(
+    ctx,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+):
+    """Returns ``(world_model, actor, critic, ensemble_mlp, params, latent_size)`` where
+    ``actor``/``critic`` are the module definitions shared by the task and exploration
+    heads (pure-functional params make sharing a module across heads free)."""
+    world_model, actor, critic, dv3_params, latent_size = dv3_build_agent(
+        ctx, actions_dim, is_continuous, cfg, obs_space
+    )
+
+    actor_expl_params = actor.init(ctx.rng(), jnp.zeros((1, latent_size)), ctx.rng())
+    if cfg.algo.hafner_initialization:
+        actor_expl_params = {"params": apply_hafner_init(actor_expl_params["params"], ctx.rng())}
+
+    critics_exploration: Dict[str, Dict[str, Any]] = {}
+    intrinsic_critics = 0
+    for k, v in cfg.algo.critics_exploration.items():
+        if v["weight"] > 0:
+            if v["reward_type"] == "intrinsic":
+                intrinsic_critics += 1
+            cp = critic.init(ctx.rng(), jnp.zeros((1, latent_size)))
+            if cfg.algo.hafner_initialization:
+                cp = {"params": zero_init_head(cp["params"], "head")}
+            critics_exploration[k] = {
+                "module": ctx.replicate(cp),
+                "target": ctx.replicate(jax.tree.map(lambda x: x, cp)),
+            }
+    if intrinsic_critics == 0:
+        raise RuntimeError("You must specify at least one intrinsic critic (`reward_type='intrinsic'`)")
+
+    wm_cfg = cfg.algo.world_model
+    stoch_size = wm_cfg.stochastic_size * wm_cfg.discrete_size
+    ens_cfg = cfg.algo.ensembles
+    ensemble_mlp, ensemble_params = build_ensembles(
+        ctx.rng(),
+        n=ens_cfg.n,
+        input_dim=int(sum(actions_dim)) + wm_cfg.recurrent_model.recurrent_state_size + stoch_size,
+        output_dim=stoch_size,
+        dense_units=ens_cfg.dense_units,
+        mlp_layers=ens_cfg.mlp_layers,
+        activation="silu",
+        layer_norm=True,
+        dtype=ctx.compute_dtype,
+    )
+
+    params = {
+        "world_model": dv3_params["world_model"],
+        "actor_task": dv3_params["actor"],
+        "critic_task": dv3_params["critic"],
+        "target_critic_task": dv3_params["target_critic"],
+        "actor_exploration": ctx.replicate(actor_expl_params),
+        "critics_exploration": critics_exploration,
+        "ensembles": ctx.replicate(ensemble_params),
+    }
+    return world_model, actor, critic, ensemble_mlp, params, latent_size

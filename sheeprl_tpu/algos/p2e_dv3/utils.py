@@ -1,0 +1,37 @@
+"""P2E-DV3 helpers (reference: ``/root/reference/sheeprl/algos/p2e_dv3/utils.py``)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401
+    init_moments,
+    prepare_obs,
+    test,
+    update_moments,
+)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_exploration",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+}
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+    "moments_task",
+    "moments_exploration",
+}

@@ -71,6 +71,12 @@ def run_algorithm(cfg: DotDict) -> None:
     from sheeprl_tpu.utils.metric import MetricAggregator
 
     entry = get_algorithm(cfg.algo.name)
+    kwargs: Dict[str, Any] = {}
+    if "finetuning" in cfg.algo.name and "p2e" in entry["module"]:
+        # Load + merge the exploration run's env config (reference cli.py:117-148).
+        from sheeprl_tpu.algos.p2e import load_exploration_config
+
+        kwargs["exploration_cfg"] = load_exploration_config(cfg)
     maybe_init_distributed(cfg.get("mesh", {}))
     ctx = make_mesh_context(cfg)
 
@@ -78,7 +84,7 @@ def run_algorithm(cfg: DotDict) -> None:
         timer.disabled = True
     MetricAggregator.disabled = cfg.metric.get("log_level", 1) == 0
 
-    entry["entrypoint"](ctx, cfg)
+    entry["entrypoint"](ctx, cfg, **kwargs)
 
 
 def eval_algorithm(cfg: DotDict) -> None:

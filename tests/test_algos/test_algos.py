@@ -256,3 +256,40 @@ def test_dreamer_v1_resume_and_evaluate(tmp_path):
         + standard_args(tmp_path, extra=["dry_run=False"])
     )
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+P2E_DV3_ARGS = [
+    "exp=p2e_dv3_dummy",
+    "algo.total_steps=32",
+    "algo.learning_starts=16",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv3_exploration_dummy_envs(tmp_path, env_id):
+    run(P2E_DV3_ARGS + [f"env={env_id}"] + standard_args(tmp_path, extra=["dry_run=False"]))
+
+
+def test_p2e_dv3_finetuning_from_exploration(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(P2E_DV3_ARGS + ["env=discrete_dummy"] + standard_args(tmp_path, extra=["dry_run=False"]))
+    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    assert ckpts
+    run(
+        P2E_DV3_ARGS
+        + [
+            "env=discrete_dummy",
+            "algo.name=p2e_dv3_finetuning",
+            f"checkpoint.exploration_ckpt_path={ckpts[-1]}",
+            "buffer.load_from_exploration=True",
+            "algo.total_steps=48",
+            # deliberately wrong: the exploration run's architecture must win
+            # (reference p2e_dv3_finetuning.py:46-69), or template loading crashes
+            "algo.dense_units=32",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    fntn_ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    assert len(fntn_ckpts) > len(ckpts)
+    evaluate([f"checkpoint_path={fntn_ckpts[-1]}", "env.capture_video=False"])
