@@ -13,6 +13,12 @@ from sheeprl_tpu.algos.dreamer_v1 import evaluate as _dv1_eval  # noqa: F401
 from sheeprl_tpu.algos.p2e_dv3 import p2e_dv3_exploration as _p2e_dv3_expl  # noqa: F401
 from sheeprl_tpu.algos.p2e_dv3 import p2e_dv3_finetuning as _p2e_dv3_fntn  # noqa: F401
 from sheeprl_tpu.algos.p2e_dv3 import evaluate as _p2e_dv3_eval  # noqa: F401
+from sheeprl_tpu.algos.p2e_dv2 import p2e_dv2_exploration as _p2e_dv2_expl  # noqa: F401
+from sheeprl_tpu.algos.p2e_dv2 import p2e_dv2_finetuning as _p2e_dv2_fntn  # noqa: F401
+from sheeprl_tpu.algos.p2e_dv2 import evaluate as _p2e_dv2_eval  # noqa: F401
+from sheeprl_tpu.algos.p2e_dv1 import p2e_dv1_exploration as _p2e_dv1_expl  # noqa: F401
+from sheeprl_tpu.algos.p2e_dv1 import p2e_dv1_finetuning as _p2e_dv1_fntn  # noqa: F401
+from sheeprl_tpu.algos.p2e_dv1 import evaluate as _p2e_dv1_eval  # noqa: F401
 from sheeprl_tpu.algos.a2c import a2c as _a2c  # noqa: F401
 from sheeprl_tpu.algos.droq import droq as _droq  # noqa: F401
 from sheeprl_tpu.algos.ppo_recurrent import ppo_recurrent as _ppo_rec  # noqa: F401

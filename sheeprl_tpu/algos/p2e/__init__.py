@@ -56,6 +56,16 @@ def ensemble_loss(mlp: MLP, stacked_params: Any, inputs: jax.Array, targets: jax
     return jnp.mean(sq, axis=(1, 2)).sum()
 
 
+def ensemble_loss_normal(mlp: MLP, stacked_params: Any, inputs: jax.Array, targets: jax.Array) -> jax.Array:
+    """DV1/DV2 variant: unit-variance Gaussian NLL instead of raw MSE (reference
+    ``p2e_dv2_exploration.py:198-210``, ``p2e_dv1_exploration.py:168-174``)."""
+    preds = ensemble_apply(mlp, stacked_params, inputs)[:, :-1]  # [N, T-1, B, D]
+    dim = targets.shape[-1]
+    log_norm = 0.5 * dim * jnp.log(2 * jnp.pi)
+    nll = 0.5 * jnp.sum((preds - targets[None]) ** 2, -1) + log_norm
+    return jnp.mean(nll, axis=(1, 2)).sum()
+
+
 def intrinsic_reward(
     mlp: MLP, stacked_params: Any, inputs: jax.Array, multiplier: float
 ) -> jax.Array:

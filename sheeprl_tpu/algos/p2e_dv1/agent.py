@@ -1,0 +1,76 @@
+"""P2E-DV1 agent builder (reference: ``/root/reference/sheeprl/algos/p2e_dv1/agent.py``).
+
+DreamerV1 stack + exploration actor and critic (no target critics in DV1) and a
+disagreement ensemble predicting the next **observation embedding** (reference
+``agent.py:128-141``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import gymnasium
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v1.agent import (
+    PlayerState,  # noqa: F401
+    build_agent as dv1_build_agent,
+    make_player_step,  # noqa: F401
+)
+from sheeprl_tpu.algos.dreamer_v2.agent import _xavier_normal_init
+from sheeprl_tpu.algos.dreamer_v3.agent import parse_actions_dim  # noqa: F401
+from sheeprl_tpu.algos.p2e import build_ensembles
+
+
+def embedding_dim(cfg, obs_space) -> int:
+    """Encoder output size: VALID 4-stage CNN trunk + dense trunk (reference derives it
+    from the built encoder, ``agent.py:131-136``)."""
+    dim = 0
+    if cfg.algo.cnn_keys.encoder:
+        final = cfg.env.screen_size
+        for _ in range(4):
+            final = (final - 4) // 2 + 1
+        dim += final * final * cfg.algo.world_model.encoder.cnn_channels_multiplier * 8
+    if cfg.algo.mlp_keys.encoder:
+        dim += cfg.algo.dense_units
+    return dim
+
+
+def build_agent(
+    ctx,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+):
+    world_model, actor, critic, dv1_params, latent_size = dv1_build_agent(
+        ctx, actions_dim, is_continuous, cfg, obs_space
+    )
+
+    actor_expl_params = actor.init(ctx.rng(), jnp.zeros((1, latent_size)), ctx.rng())
+    actor_expl_params = {"params": _xavier_normal_init(actor_expl_params["params"], ctx.rng())}
+    critic_expl_params = critic.init(ctx.rng(), jnp.zeros((1, latent_size)))
+    critic_expl_params = {"params": _xavier_normal_init(critic_expl_params["params"], ctx.rng())}
+
+    wm_cfg = cfg.algo.world_model
+    ens_cfg = cfg.algo.ensembles
+    ensemble_mlp, ensemble_params = build_ensembles(
+        ctx.rng(),
+        n=ens_cfg.n,
+        input_dim=int(sum(actions_dim)) + wm_cfg.recurrent_model.recurrent_state_size + wm_cfg.stochastic_size,
+        output_dim=embedding_dim(cfg, obs_space),
+        dense_units=ens_cfg.dense_units,
+        mlp_layers=ens_cfg.mlp_layers,
+        activation=cfg.algo.dense_act,
+        layer_norm=False,
+        dtype=ctx.compute_dtype,
+    )
+
+    params = {
+        "world_model": dv1_params["world_model"],
+        "actor_task": dv1_params["actor"],
+        "critic_task": dv1_params["critic"],
+        "actor_exploration": ctx.replicate(actor_expl_params),
+        "critic_exploration": ctx.replicate(critic_expl_params),
+        "ensembles": ctx.replicate(ensemble_params),
+    }
+    return world_model, actor, critic, ensemble_mlp, params, latent_size

@@ -1,19 +1,10 @@
-"""P2E-DV3 finetuning (reference: ``/root/reference/sheeprl/algos/p2e_dv3/p2e_dv3_finetuning.py``).
+"""P2E-DV1 finetuning (reference: ``/root/reference/sheeprl/algos/p2e_dv1/p2e_dv1_finetuning.py``).
 
-Loads the exploration checkpoint (world model + both actors + task critic + optimizer
-states + task Moments, reference ``:130-170``) and finetunes the TASK policy with the
-standard DreamerV3 train step — the functional param split makes this literally the DV3
-``train_step`` applied to the ``{world_model, actor_task, critic_task,
-target_critic_task}`` slice of the Plan2Explore parameter tree.
-
-The player starts acting with the exploration actor and switches to the task actor at
-the first gradient step (reference ``:350-352``; ``algo.player.actor_type`` selects the
-starting actor).
-"""
+Loads the exploration checkpoint and finetunes the task policy with the standard
+DreamerV1 train step applied to the ``{world_model, actor_task, critic_task}`` slice."""
 
 from __future__ import annotations
 
-import os
 import time
 from pathlib import Path
 from typing import Dict
@@ -22,12 +13,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.dreamer_v3.agent import PlayerState, make_player_step
-from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step as make_dv3_train_step
-from sheeprl_tpu.algos.p2e import load_exploration_config  # noqa: F401  (re-export for the CLI)
-from sheeprl_tpu.algos.p2e_dv3.agent import build_agent, parse_actions_dim
-from sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration import make_train_step as make_expl_train_step
-from sheeprl_tpu.algos.p2e_dv3.utils import AGGREGATOR_KEYS, init_moments, prepare_obs, test
+from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import make_train_step as make_dv1_train_step
+from sheeprl_tpu.algos.dreamer_v2.agent import exploration_amount
+from sheeprl_tpu.algos.p2e import load_exploration_config
+from sheeprl_tpu.algos.p2e_dv1.agent import PlayerState, build_agent, make_player_step, parse_actions_dim
+from sheeprl_tpu.algos.p2e_dv1.p2e_dv1_exploration import make_train_step as make_expl_train_step
+from sheeprl_tpu.algos.p2e_dv1.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
@@ -37,12 +28,15 @@ from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio
+import os
 
 
-@register_algorithm(name="p2e_dv3_finetuning")
+@register_algorithm(name="p2e_dv1_finetuning")
 def main(ctx, cfg, exploration_cfg=None) -> None:
     if exploration_cfg is None:
         exploration_cfg = load_exploration_config(cfg)
+    cfg.env.screen_size = 64
+    cfg.env.frame_stack = 1
     rank = ctx.process_index
     log_dir = get_log_dir(cfg)
     if ctx.is_global_zero:
@@ -60,52 +54,30 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
     num_envs = cfg.env.num_envs
     world = jax.process_count()
 
-    critic_cfgs = {
-        k: {"weight": v["weight"], "reward_type": v["reward_type"]}
-        for k, v in cfg.algo.critics_exploration.items()
-        if v["weight"] > 0
-    }
     world_model, actor, critic, ensemble_mlp, params, _ = build_agent(
         ctx, actions_dim, is_continuous, cfg, obs_space
     )
-    # Exploration-shaped state templates (for loading the exploration checkpoint).
-    _, expl_init_opt, expl_init_moments = make_expl_train_step(
-        world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp_keys, critic_cfgs
-    )
-    expl_opt_template = expl_init_opt(params)
-    expl_moments_template = expl_init_moments()
+    _, expl_init_opt = make_expl_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp_keys)
+    expl_opt_host = jax.device_get(expl_init_opt(params))
 
-    # The finetuning train step IS the DV3 one over the task slice.
-    train_step, init_opt_states = make_dv3_train_step(
-        world_model, actor, critic, cfg, cnn_keys, mlp_keys, {k: obs_space[k].shape for k in obs_keys}
-    )
+    train_step, init_opt_states = make_dv1_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys)
     train_jit = jax.jit(train_step)
 
     def task_view(p):
-        return {
-            "world_model": p["world_model"],
-            "actor": p["actor_task"],
-            "critic": p["critic_task"],
-            "target_critic": p["target_critic_task"],
-        }
+        return {"world_model": p["world_model"], "actor": p["actor_task"], "critic": p["critic_task"]}
 
     def merge_task_view(p, view):
         p = dict(p)
         p["world_model"] = view["world_model"]
         p["actor_task"] = view["actor"]
         p["critic_task"] = view["critic"]
-        p["target_critic_task"] = view["target_critic"]
         return p
 
     resume_from = cfg.checkpoint.get("resume_from")
     ckpt_to_load = resume_from or cfg.checkpoint.exploration_ckpt_path
     state = CheckpointManager.load(
         ckpt_to_load,
-        templates={
-            "params": jax.device_get(params),
-            "opt_states": jax.device_get(expl_opt_template),
-            "moments": jax.device_get(expl_moments_template),
-        },
+        templates={"params": jax.device_get(params), "opt_states": expl_opt_host},
     )
     params = ctx.replicate(state["params"])
     loaded_opts = state["opt_states"]
@@ -116,12 +88,11 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             "critic": loaded_opts["critic_task"],
         }
     )
-    moments_state = ctx.replicate(state["moments"]["task"])
 
-    player_step = make_player_step(world_model, actor, actions_dim, cfg.algo.world_model.discrete_size)
+    player_step = make_player_step(world_model, actor, actions_dim, is_continuous)
     player_jit = jax.jit(player_step, static_argnames=("greedy",))
     actor_type = cfg.algo.player.get("actor_type", "exploration")
-    stoch_size = cfg.algo.world_model.stochastic_size * cfg.algo.world_model.discrete_size
+    stoch_size = cfg.algo.world_model.stochastic_size
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
 
     def player_params():
@@ -159,7 +130,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
     total_steps = int(cfg.algo.total_steps)
     num_iters = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
     learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
-    target_update_freq = cfg.algo.critic.per_rank_target_network_update_freq
+    expl_cfg = cfg.algo.actor
 
     start_iter = 1
     policy_step = 0
@@ -198,12 +169,13 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
 
     for iter_num in range(start_iter, num_iters + 1):
         env_t0 = time.perf_counter()
+        expl_amount = exploration_amount(
+            expl_cfg.get("expl_amount", 0.0), expl_cfg.get("expl_decay", 0.0), expl_cfg.get("expl_min", 0.0), policy_step
+        )
         with timer("Time/env_interaction_time"):
-            # The exploration policy (or the loaded task policy) acts from the start —
-            # no random prefill, the agent is pretrained (reference :330-:352).
             obs_t = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
             actions, stored, player_state = player_jit(
-                player_params(), player_state, obs_t, jnp.asarray(is_first_np), ctx.rng()
+                player_params(), player_state, obs_t, jnp.asarray(is_first_np), ctx.rng(), jnp.asarray(expl_amount)
             )
             stored_actions = np.asarray(jax.device_get(stored))
             acts_np = [np.asarray(jax.device_get(a)) for a in actions]
@@ -219,7 +191,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
 
             next_obs, reward, terminated, truncated, info = envs.step(env_actions)
             if cfg.env.clip_rewards:
-                reward = np.clip(reward, -1, 1)
+                reward = np.tanh(reward)
             done = np.logical_or(terminated, truncated)
             reward = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)
 
@@ -260,8 +232,6 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
         grad_steps = 0
         if iter_num >= learning_starts:
             if actor_type != "task":
-                # Switch the player to the task actor at the first gradient step
-                # (reference :350-352).
                 actor_type = "task"
             grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
             if grad_steps > 0:
@@ -281,11 +251,8 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
                     view = task_view(params)
                     for g in range(grad_steps):
                         batch = {k: v[g] for k, v in sample.items()}
-                        update_target = jnp.asarray(cumulative_grad_steps % target_update_freq == 0)
                         cumulative_grad_steps += 1
-                        view, opt_states, moments_state, train_metrics = train_jit(
-                            view, opt_states, moments_state, batch, ctx.rng(), update_target
-                        )
+                        view, opt_states, train_metrics = train_jit(view, opt_states, batch, ctx.rng())
                     params = merge_task_view(params, view)
                     train_metrics = jax.device_get(train_metrics)
                     train_time = time.perf_counter() - t0
@@ -314,19 +281,15 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             or iter_num == num_iters
             and cfg.checkpoint.save_last
         ):
-            # Save the exploration-shaped state so both resume (this entry) and
-            # evaluation can reload it with the same templates; untrained entries
-            # keep the optimizer moments loaded from the exploration checkpoint.
+            # untrained entries keep the optimizer moments loaded from the exploration ckpt
             full_opts = dict(loaded_opts)
             on_device = jax.device_get(opt_states)
             full_opts["world_model"] = on_device["world_model"]
             full_opts["actor_task"] = on_device["actor"]
             full_opts["critic_task"] = on_device["critic"]
-            full_moments = {"task": moments_state, "expl": expl_moments_template}
             ckpt_state = {
                 "params": params,
                 "opt_states": full_opts,
-                "moments": full_moments,
                 "ratio": ratio.state_dict(),
                 "iter_num": iter_num,
                 "policy_step": policy_step,

@@ -1,0 +1,68 @@
+"""P2E-DV2 agent builder (reference: ``/root/reference/sheeprl/algos/p2e_dv2/agent.py``).
+
+DreamerV2 stack + exploration actor, ONE exploration critic with a hard-copy target
+(reference ``agent.py:118-147``), and a disagreement ensemble predicting the next
+stochastic state with a unit-variance Gaussian likelihood."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    ActorV2,
+    CriticV2,
+    PlayerState,  # noqa: F401
+    _xavier_normal_init,
+    build_agent as dv2_build_agent,
+    make_player_step,  # noqa: F401
+)
+from sheeprl_tpu.algos.dreamer_v3.agent import parse_actions_dim  # noqa: F401
+from sheeprl_tpu.algos.p2e import build_ensembles
+
+
+def build_agent(
+    ctx,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+):
+    world_model, actor, critic, dv2_params, latent_size = dv2_build_agent(
+        ctx, actions_dim, is_continuous, cfg, obs_space
+    )
+
+    actor_expl_params = actor.init(ctx.rng(), jnp.zeros((1, latent_size)), ctx.rng())
+    actor_expl_params = {"params": _xavier_normal_init(actor_expl_params["params"], ctx.rng())}
+    critic_expl_params = critic.init(ctx.rng(), jnp.zeros((1, latent_size)))
+    critic_expl_params = {"params": _xavier_normal_init(critic_expl_params["params"], ctx.rng())}
+
+    wm_cfg = cfg.algo.world_model
+    stoch_size = wm_cfg.stochastic_size * wm_cfg.discrete_size
+    ens_cfg = cfg.algo.ensembles
+    ensemble_mlp, ensemble_params = build_ensembles(
+        ctx.rng(),
+        n=ens_cfg.n,
+        input_dim=int(sum(actions_dim)) + wm_cfg.recurrent_model.recurrent_state_size + stoch_size,
+        output_dim=stoch_size,
+        dense_units=ens_cfg.dense_units,
+        mlp_layers=ens_cfg.mlp_layers,
+        activation=cfg.algo.dense_act,
+        layer_norm=cfg.algo.layer_norm,
+        dtype=ctx.compute_dtype,
+    )
+
+    params = {
+        "world_model": dv2_params["world_model"],
+        "actor_task": dv2_params["actor"],
+        "critic_task": dv2_params["critic"],
+        "target_critic_task": dv2_params["target_critic"],
+        "actor_exploration": ctx.replicate(actor_expl_params),
+        "critic_exploration": ctx.replicate(critic_expl_params),
+        "target_critic_exploration": ctx.replicate(jax.tree.map(lambda x: x, critic_expl_params)),
+        "ensembles": ctx.replicate(ensemble_params),
+    }
+    return world_model, actor, critic, ensemble_mlp, params, latent_size

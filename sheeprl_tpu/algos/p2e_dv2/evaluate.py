@@ -1,0 +1,53 @@
+"""P2E-DV2 evaluation (reference: ``algos/p2e_dv2/evaluate.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.p2e_dv2.agent import PlayerState, build_agent, make_player_step, parse_actions_dim
+from sheeprl_tpu.algos.p2e_dv2.utils import test
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["p2e_dv2_exploration", "p2e_dv2_finetuning"])
+def evaluate_p2e_dv2(ctx, cfg: Dict[str, Any], ckpt_path: str) -> float:
+    log_dir = get_log_dir(cfg)
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    obs_space = env.observation_space
+    act_space = env.action_space
+    env.close()
+    is_continuous, actions_dim = parse_actions_dim(act_space)
+
+    world_model, actor, critic, ensemble_mlp, params, _ = build_agent(
+        ctx, actions_dim, is_continuous, cfg, obs_space
+    )
+    state = CheckpointManager.load(ckpt_path, templates={"params": jax.device_get(params)})
+    params = ctx.replicate(state["params"])
+
+    actor_type = cfg.algo.player.get("actor_type", "exploration")
+    if "finetuning" in cfg.algo.name:
+        actor_type = "task"
+    actor_key = "actor_exploration" if actor_type == "exploration" else "actor_task"
+
+    stoch_size = cfg.algo.world_model.stochastic_size * cfg.algo.world_model.discrete_size
+    rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
+    act_dim_sum = int(sum(actions_dim))
+
+    def player_state_init(n: int) -> PlayerState:
+        return PlayerState(
+            recurrent_state=jnp.zeros((n, rec_size)),
+            stochastic_state=jnp.zeros((n, stoch_size)),
+            actions=jnp.zeros((n, act_dim_sum)),
+        )
+
+    player_step = make_player_step(world_model, actor, actions_dim, is_continuous)
+    player_view = {"world_model": params["world_model"], "actor": params[actor_key]}
+    reward = test(player_step, player_view, player_state_init, ctx, cfg, log_dir)
+    print(f"Test/cumulative_reward: {reward}")
+    return reward

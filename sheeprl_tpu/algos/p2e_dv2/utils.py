@@ -1,0 +1,36 @@
+"""P2E-DV2 helpers (reference: ``/root/reference/sheeprl/algos/p2e_dv2/utils.py``)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_exploration",
+    "Loss/value_loss_exploration",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Rewards/intrinsic",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+}
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "critic_exploration",
+    "target_critic_exploration",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+}

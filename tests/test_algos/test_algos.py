@@ -21,6 +21,11 @@ def standard_args(tmp_path, extra=()):
     ]
 
 
+def _ckpts(tmp_path):
+    # mtime order: lexicographic sort would put ckpt_8 after ckpt_32
+    return sorted(tmp_path.rglob("ckpt_*"), key=lambda p: p.stat().st_mtime)
+
+
 PPO_ARGS = [
     "exp=ppo",
     "algo.rollout_steps=8",
@@ -52,7 +57,7 @@ def test_ppo_resume_from_checkpoint(tmp_path):
         + ["env=discrete_dummy", "algo.mlp_keys.encoder=[state]", "algo.total_steps=32"]
         + standard_args(tmp_path)
     )
-    ckpts = sorted((tmp_path).rglob("ckpt_*"))
+    ckpts = _ckpts(tmp_path)
     assert ckpts, "no checkpoint written"
     run(
         PPO_ARGS
@@ -69,7 +74,7 @@ def test_ppo_evaluate_roundtrip(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
     run(PPO_ARGS + ["env=discrete_dummy", "algo.mlp_keys.encoder=[state]"] + standard_args(tmp_path))
-    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    ckpts = _ckpts(tmp_path)
     assert ckpts
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
 
@@ -94,7 +99,7 @@ def test_sac_resume_and_evaluate(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
     run(SAC_ARGS + standard_args(tmp_path, extra=["dry_run=False"]))
-    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    ckpts = _ckpts(tmp_path)
     assert ckpts
     run(SAC_ARGS + [f"checkpoint.resume_from={ckpts[-1]}", "algo.total_steps=24"] + standard_args(tmp_path, extra=["dry_run=False"]))
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
@@ -116,7 +121,7 @@ def test_dreamer_v3_resume_and_evaluate(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
     run(DV3_ARGS + ["env=discrete_dummy"] + standard_args(tmp_path, extra=["dry_run=False"]))
-    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    ckpts = _ckpts(tmp_path)
     assert ckpts
     run(
         DV3_ARGS
@@ -222,7 +227,7 @@ def test_dreamer_v2_resume_and_evaluate(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
     run(DV2_ARGS + ["env=discrete_dummy"] + standard_args(tmp_path, extra=["dry_run=False"]))
-    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    ckpts = _ckpts(tmp_path)
     assert ckpts
     run(
         DV2_ARGS
@@ -248,7 +253,7 @@ def test_dreamer_v1_resume_and_evaluate(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
     run(DV1_ARGS + ["env=discrete_dummy"] + standard_args(tmp_path, extra=["dry_run=False"]))
-    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    ckpts = _ckpts(tmp_path)
     assert ckpts
     run(
         DV1_ARGS
@@ -274,7 +279,7 @@ def test_p2e_dv3_finetuning_from_exploration(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
     run(P2E_DV3_ARGS + ["env=discrete_dummy"] + standard_args(tmp_path, extra=["dry_run=False"]))
-    ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    ckpts = _ckpts(tmp_path)
     assert ckpts
     run(
         P2E_DV3_ARGS
@@ -290,6 +295,30 @@ def test_p2e_dv3_finetuning_from_exploration(tmp_path):
         ]
         + standard_args(tmp_path, extra=["dry_run=False"])
     )
-    fntn_ckpts = sorted(tmp_path.rglob("ckpt_*"))
+    fntn_ckpts = _ckpts(tmp_path)
+    assert len(fntn_ckpts) > len(ckpts)
+    evaluate([f"checkpoint_path={fntn_ckpts[-1]}", "env.capture_video=False"])
+
+
+@pytest.mark.parametrize("base", ["p2e_dv1", "p2e_dv2"])
+def test_p2e_dv12_exploration_and_finetuning(tmp_path, base):
+    from sheeprl_tpu.cli import evaluate
+
+    args = [f"exp={base}_dummy", "algo.total_steps=32", "algo.learning_starts=16"]
+    run(args + ["env=discrete_dummy"] + standard_args(tmp_path, extra=["dry_run=False"]))
+    ckpts = _ckpts(tmp_path)
+    assert ckpts
+    run(
+        args
+        + [
+            "env=discrete_dummy",
+            f"algo.name={base}_finetuning",
+            f"checkpoint.exploration_ckpt_path={ckpts[-1]}",
+            "buffer.load_from_exploration=True",
+            "algo.total_steps=48",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    fntn_ckpts = _ckpts(tmp_path)
     assert len(fntn_ckpts) > len(ckpts)
     evaluate([f"checkpoint_path={fntn_ckpts[-1]}", "env.capture_video=False"])
