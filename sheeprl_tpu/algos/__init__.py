@@ -1,8 +1,10 @@
 """Algorithm registry population (reference: ``sheeprl/__init__.py:18-47``)."""
 
 from sheeprl_tpu.algos.ppo import ppo as _ppo  # noqa: F401
+from sheeprl_tpu.algos.ppo import ppo_decoupled as _ppo_dec  # noqa: F401
 from sheeprl_tpu.algos.ppo import evaluate as _ppo_eval  # noqa: F401
 from sheeprl_tpu.algos.sac import sac as _sac  # noqa: F401
+from sheeprl_tpu.algos.sac import sac_decoupled as _sac_dec  # noqa: F401
 from sheeprl_tpu.algos.sac import evaluate as _sac_eval  # noqa: F401
 from sheeprl_tpu.algos.dreamer_v3 import dreamer_v3 as _dv3  # noqa: F401
 from sheeprl_tpu.algos.dreamer_v3 import evaluate as _dv3_eval  # noqa: F401

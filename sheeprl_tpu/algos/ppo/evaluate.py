@@ -15,7 +15,7 @@ from sheeprl_tpu.utils.logger import get_log_dir
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
-@register_evaluation(algorithms=["ppo", "a2c"])
+@register_evaluation(algorithms=["ppo", "ppo_decoupled", "a2c"])
 def evaluate_ppo(ctx, cfg: Dict[str, Any], ckpt_path: str) -> float:
     log_dir = get_log_dir(cfg)
     env = make_env(cfg, cfg.seed, 0, log_dir, "test")()

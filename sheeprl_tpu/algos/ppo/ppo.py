@@ -74,6 +74,97 @@ def make_optimizer(opt_cfg: Dict[str, Any], max_grad_norm: float, lr_schedule=No
     return opt
 
 
+class PPOTrainFns:
+    """Jitted PPO functions shared by the coupled and decoupled entry points."""
+
+    def __init__(self, ctx, agent, cfg, obs_keys, num_updates):
+        if cfg.algo.per_rank_batch_size <= 0:
+            raise ValueError("algo.per_rank_batch_size must be positive")
+        num_envs = cfg.env.num_envs
+        rollout_steps = cfg.algo.rollout_steps
+        batch_n = rollout_steps * num_envs
+        if batch_n % cfg.algo.per_rank_batch_size != 0:
+            raise ValueError(
+                f"algo.rollout_steps*env.num_envs ({batch_n}) must be divisible by "
+                f"algo.per_rank_batch_size ({cfg.algo.per_rank_batch_size}): static shapes "
+                "inside the jitted update require equal minibatches."
+            )
+        self.batch_n = batch_n
+        self.num_minibatches = batch_n // cfg.algo.per_rank_batch_size
+        self.grad_steps_per_update = cfg.algo.update_epochs * self.num_minibatches
+        self.lr_schedule = None
+        if cfg.algo.anneal_lr:
+            self.lr_schedule = optax.polynomial_schedule(
+                init_value=cfg.algo.optimizer.lr,
+                end_value=1e-8,
+                power=1.0,
+                transition_steps=num_updates * self.grad_steps_per_update,
+            )
+        self.opt = make_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm, self.lr_schedule)
+
+        is_continuous = agent.is_continuous
+        batch_sharding = ctx.batch_sharding()
+        gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
+        loss_reduction = cfg.algo.loss_reduction
+        mb_size = cfg.algo.per_rank_batch_size
+        num_minibatches = self.num_minibatches
+        opt = self.opt
+
+        @jax.jit
+        def act_fn(p, obs, key):
+            actor_out, value = agent.apply(p, obs)
+            env_act, stored_act, logprob = sample_actions(key, actor_out, is_continuous)
+            return env_act, stored_act, logprob, value[..., 0]
+
+        @jax.jit
+        def values_fn(p, obs):
+            _, value = agent.apply(p, obs)
+            return value[..., 0]
+
+        def loss_fn(p, mb, clip_coef, ent_coef):
+            actor_out, new_values = agent.apply(p, {k: mb[k] for k in obs_keys})
+            new_logprob, entropy = log_prob_and_entropy(actor_out, mb["actions"], is_continuous)
+            adv = mb["advantages"]
+            if cfg.algo.normalize_advantages:
+                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pg = policy_loss(new_logprob, mb["logprobs"], adv, clip_coef, loss_reduction)
+            vf = value_loss(
+                new_values[..., 0], mb["values"], mb["returns"], clip_coef, cfg.algo.clip_vloss, loss_reduction
+            )
+            ent = entropy_loss(entropy, loss_reduction)
+            total = pg + cfg.algo.vf_coef * vf + ent_coef * ent
+            return total, {"Loss/policy_loss": pg, "Loss/value_loss": vf, "Loss/entropy_loss": -ent}
+
+        @jax.jit
+        def train_fn(p, o_state, data, key, clip_coef, ent_coef):
+            n = data["actions"].shape[0]
+
+            def mb_step(carry, idx):
+                p, o_state = carry
+                mb = jax.tree.map(lambda x: jax.lax.with_sharding_constraint(x[idx], batch_sharding), data)
+                (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, mb, clip_coef, ent_coef)
+                updates, o_state = opt.update(grads, o_state, p)
+                p = optax.apply_updates(p, updates)
+                return (p, o_state), aux
+
+            def epoch_step(carry, ekey):
+                perm = jax.random.permutation(ekey, n)
+                idxs = perm.reshape(num_minibatches, mb_size)
+                carry, auxs = jax.lax.scan(mb_step, carry, idxs)
+                return carry, jax.tree.map(jnp.mean, auxs)
+
+            keys = jax.random.split(key, cfg.algo.update_epochs)
+            (p, o_state), metrics = jax.lax.scan(epoch_step, (p, o_state), keys)
+            return p, o_state, jax.tree.map(jnp.mean, metrics)
+
+        self.act_fn = act_fn
+        self.values_fn = values_fn
+        self.train_fn = train_fn
+        self.gae_fn = jax.jit(
+            lambda rew, vals, dones, next_v: gae(rew, vals, dones, next_v, rollout_steps, gamma, gae_lambda)
+        )
+
+
 @register_algorithm(name="ppo")
 def main(ctx, cfg) -> None:
     rank = ctx.process_index
@@ -102,26 +193,11 @@ def main(ctx, cfg) -> None:
     total_steps = int(cfg.algo.total_steps)
     num_updates = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
 
-    # Optimizer with optional lr annealing as an optax schedule over gradient steps.
-    batch_n = rollout_steps * num_envs
-    if batch_n % cfg.algo.per_rank_batch_size != 0:
-        raise ValueError(
-            f"algo.rollout_steps*env.num_envs ({batch_n}) must be divisible by "
-            f"algo.per_rank_batch_size ({cfg.algo.per_rank_batch_size}): static shapes "
-            "inside the jitted update require equal minibatches."
-        )
-    num_minibatches = batch_n // cfg.algo.per_rank_batch_size
-    grad_steps_per_update = cfg.algo.update_epochs * num_minibatches
-    lr_schedule = None
-    if cfg.algo.anneal_lr:
-        lr_schedule = optax.polynomial_schedule(
-            init_value=cfg.algo.optimizer.lr,
-            end_value=1e-8,
-            power=1.0,
-            transition_steps=num_updates * grad_steps_per_update,
-        )
-    opt = make_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm, lr_schedule)
-    opt_state = ctx.replicate(opt.init(params))
+    fns = PPOTrainFns(ctx, agent, cfg, obs_keys, num_updates)
+    batch_n = fns.batch_n
+    grad_steps_per_update = fns.grad_steps_per_update
+    lr_schedule = fns.lr_schedule
+    opt_state = ctx.replicate(fns.opt.init(params))
 
     rb = ReplayBuffer(
         rollout_steps,
@@ -136,62 +212,8 @@ def main(ctx, cfg) -> None:
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
 
-    batch_sharding = ctx.batch_sharding()
-
-    # ------------------------------------------------------------------ jitted fns
-    @jax.jit
-    def act_fn(p, obs, key):
-        actor_out, value = agent.apply(p, obs)
-        env_act, stored_act, logprob = sample_actions(key, actor_out, is_continuous)
-        return env_act, stored_act, logprob, value[..., 0]
-
-    @jax.jit
-    def values_fn(p, obs):
-        _, value = agent.apply(p, obs)
-        return value[..., 0]
-
-    gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
-    loss_reduction = cfg.algo.loss_reduction
-
-    def loss_fn(p, mb, clip_coef, ent_coef):
-        actor_out, new_values = agent.apply(p, {k: mb[k] for k in obs_keys})
-        new_logprob, entropy = log_prob_and_entropy(actor_out, mb["actions"], is_continuous)
-        adv = mb["advantages"]
-        if cfg.algo.normalize_advantages:
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-        pg = policy_loss(new_logprob, mb["logprobs"], adv, clip_coef, loss_reduction)
-        vf = value_loss(new_values[..., 0], mb["values"], mb["returns"], clip_coef, cfg.algo.clip_vloss, loss_reduction)
-        ent = entropy_loss(entropy, loss_reduction)
-        total = pg + cfg.algo.vf_coef * vf + ent_coef * ent
-        return total, {"Loss/policy_loss": pg, "Loss/value_loss": vf, "Loss/entropy_loss": -ent}
-
-    mb_size = cfg.algo.per_rank_batch_size
-
-    @jax.jit
-    def train_fn(p, o_state, data, key, clip_coef, ent_coef):
-        n = data["actions"].shape[0]
-
-        def mb_step(carry, idx):
-            p, o_state = carry
-            mb = jax.tree.map(lambda x: jax.lax.with_sharding_constraint(x[idx], batch_sharding), data)
-            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, mb, clip_coef, ent_coef)
-            updates, o_state = opt.update(grads, o_state, p)
-            p = optax.apply_updates(p, updates)
-            return (p, o_state), aux
-
-        def epoch_step(carry, ekey):
-            perm = jax.random.permutation(ekey, n)
-            idxs = perm.reshape(num_minibatches, mb_size)
-            carry, auxs = jax.lax.scan(mb_step, carry, idxs)
-            return carry, jax.tree.map(jnp.mean, auxs)
-
-        keys = jax.random.split(key, cfg.algo.update_epochs)
-        (p, o_state), metrics = jax.lax.scan(epoch_step, (p, o_state), keys)
-        return p, o_state, jax.tree.map(jnp.mean, metrics)
-
-    gae_fn = jax.jit(
-        lambda rew, vals, dones, next_v: gae(rew, vals, dones, next_v, rollout_steps, gamma, gae_lambda)
-    )
+    act_fn, values_fn, train_fn, gae_fn = fns.act_fn, fns.values_fn, fns.train_fn, fns.gae_fn
+    gamma = cfg.algo.gamma
 
     # ------------------------------------------------------------------ resume
     start_update = 1

@@ -1,0 +1,287 @@
+"""Decoupled PPO — player/learner split (reference: ``/root/reference/sheeprl/algos/ppo/ppo_decoupled.py``).
+
+The reference decouples by spawning one *process* per role and moving data with torch
+collectives: rank-0 player scatters rollout shards to N DDP trainer ranks and receives
+flattened parameters back over NCCL/Gloo (``ppo_decoupled.py:294-305, 645-666``).
+
+**TPU-native redesign** (SURVEY §7 explicitly flags "don't mimic the torch
+collectives"): JAX is single-controller — ONE process already drives every local device.
+The roles become *threads* sharing the process:
+
+* the **player** thread owns the envs and a jitted single-device policy, collects a
+  rollout, computes GAE, and hands the finished batch to the learner over a bounded
+  queue (the host-side analogue of the reference's ``scatter_object_list``);
+* the **learner** (main thread) runs the jitted data-parallel update over the mesh —
+  GSPMD shards the batch over the ``data`` axis and inserts the gradient reductions —
+  then *publishes* the fresh params back through a second queue (the analogue of the
+  flattened-parameter broadcast, ``ppo_decoupled.py:302-305``, at zero copy cost:
+  device buffers are immutable, publication is a reference hand-off).
+* termination mirrors the reference's sentinel (``:344,463``): the player propagates
+  exceptions through the data queue, and a stop event prevents either side from
+  blocking forever if its peer dies.
+
+The env never waits on the optimizer's dispatch (rollout t+1 overlaps update t's
+device execution), which is the whole point of the decoupled mode.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import build_agent
+from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.config.core import save_config
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_vector_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, polynomial_decay
+
+
+@register_algorithm(name="ppo_decoupled", decoupled=True)
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    agent, params = build_agent(ctx, act_space, obs_space, cfg)
+    is_continuous = agent.is_continuous
+
+    num_envs = cfg.env.num_envs
+    rollout_steps = cfg.algo.rollout_steps
+    world = jax.process_count()
+    policy_steps_per_iter = int(num_envs * rollout_steps * world)
+    total_steps = int(cfg.algo.total_steps)
+    num_updates = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
+
+    fns = PPOTrainFns(ctx, agent, cfg, obs_keys, num_updates)
+    batch_n = fns.batch_n
+    grad_steps_per_update = fns.grad_steps_per_update
+    opt_state = ctx.replicate(fns.opt.init(params))
+    act_fn, values_fn, train_fn, gae_fn = fns.act_fn, fns.values_fn, fns.train_fn, fns.gae_fn
+    gamma = cfg.algo.gamma
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    # The aggregator is written by the player (episode stats) and read/reset by the
+    # learner (logging flush) — one lock covers both sides.
+    agg_lock = threading.Lock()
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+
+    # ------------------------------------------------------------------ resume
+    start_update = 1
+    policy_step0 = 0
+    last_log = 0
+    last_checkpoint = 0
+    if cfg.checkpoint.get("resume_from"):
+        state = CheckpointManager.load(
+            cfg.checkpoint.resume_from,
+            templates={"params": jax.device_get(params), "opt_state": jax.device_get(opt_state)},
+        )
+        params = ctx.replicate(state["params"])
+        opt_state = ctx.replicate(state["opt_state"])
+        start_update = state["update"] + 1
+        policy_step0 = state["policy_step"]
+        last_log = state.get("last_log", 0)
+        last_checkpoint = state.get("last_checkpoint", 0)
+
+    # ------------------------------------------------------------------ roles
+    rollout_q: "queue.Queue[Any]" = queue.Queue(maxsize=2)
+    param_q: "queue.Queue[Any]" = queue.Queue(maxsize=2)
+    stop = threading.Event()
+
+    rb = ReplayBuffer(
+        rollout_steps,
+        num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+
+    def player() -> None:
+        """Env-facing role (reference ``player()``, ``ppo_decoupled.py:32-365``)."""
+        # Own PRNG chain: ctx.rng() is not thread-safe and belongs to the learner.
+        key = jax.random.PRNGKey(cfg.seed + 10_000 + rank)
+        local_params = params
+        policy_step = policy_step0
+        try:
+            obs, _ = envs.reset(seed=cfg.seed + rank)
+            step_data: Dict[str, np.ndarray] = {}
+            for update in range(start_update, num_updates + 1):
+                env_t0 = time.perf_counter()
+                with timer("Time/env_interaction_time"):
+                    for _ in range(rollout_steps):
+                        if stop.is_set():
+                            return
+                        key, sub = jax.random.split(key)
+                        obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
+                        env_act, stored_act, logprob, value = act_fn(local_params, obs_t, sub)
+                        env_act_np = np.asarray(jax.device_get(env_act))
+                        if is_continuous:
+                            low, high = act_space.low, act_space.high
+                            env_actions = np.clip(env_act_np, low, high) if np.isfinite(low).all() else env_act_np
+                        elif len(agent.action_dims) == 1:
+                            env_actions = env_act_np[..., 0]
+                        else:
+                            env_actions = env_act_np
+                        next_obs, reward, terminated, truncated, info = envs.step(env_actions)
+                        if cfg.env.clip_rewards:
+                            reward = np.clip(reward, -1, 1)
+                        done = np.logical_or(terminated, truncated)
+                        reward = np.asarray(reward, dtype=np.float32).reshape(num_envs)
+
+                        if truncated.any() and "final_obs" in info:
+                            trunc_idx = np.nonzero(truncated)[0]
+                            final_obs = {
+                                k: np.stack([np.asarray(info["final_obs"][i][k]) for i in trunc_idx])
+                                for k in obs_keys
+                            }
+                            v_final = np.asarray(
+                                jax.device_get(values_fn(local_params, prepare_obs(final_obs, cnn_keys, mlp_keys)))
+                            )
+                            reward[trunc_idx] += gamma * v_final
+
+                        for k in obs_keys:
+                            step_data[k] = np.asarray(obs[k])[None]
+                        step_data["actions"] = env_act_np.reshape(num_envs, -1).astype(np.float32)[None]
+                        step_data["logprobs"] = np.asarray(jax.device_get(logprob)).reshape(num_envs, 1)[None]
+                        step_data["values"] = np.asarray(jax.device_get(value)).reshape(num_envs, 1)[None]
+                        step_data["rewards"] = reward.reshape(num_envs, 1)[None]
+                        step_data["dones"] = done.astype(np.float32).reshape(num_envs, 1)[None]
+                        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                        obs = next_obs
+                        policy_step += num_envs * world
+                        with agg_lock:
+                            record_episode_stats(aggregator, info)
+                env_time = time.perf_counter() - env_t0
+
+                local = rb.to_tensor()
+                next_value = values_fn(local_params, prepare_obs(obs, cnn_keys, mlp_keys))[:, None]
+                returns, advantages = gae_fn(local["rewards"], local["values"], local["dones"], next_value)
+                data = {
+                    **{k: local[k] for k in obs_keys},
+                    "actions": local["actions"],
+                    "logprobs": local["logprobs"][..., 0],
+                    "values": local["values"][..., 0],
+                    "returns": returns[..., 0],
+                    "advantages": advantages[..., 0],
+                }
+                data = jax.tree.map(lambda x: x.reshape(batch_n, *x.shape[2:]), data)
+                item = {"update": update, "data": data, "policy_step": policy_step, "env_time": env_time}
+                while not stop.is_set():
+                    try:
+                        rollout_q.put(item, timeout=1.0)
+                        break
+                    except queue.Full:
+                        continue
+
+                # Wait for the learner's parameter publication (reference :302-305).
+                while not stop.is_set():
+                    try:
+                        local_params = param_q.get(timeout=1.0)
+                        break
+                    except queue.Empty:
+                        continue
+        except Exception as exc:  # propagate into the learner
+            rollout_q.put(exc)
+
+    player_thread = threading.Thread(target=player, name="ppo-player", daemon=True)
+    player_thread.start()
+
+    # ------------------------------------------------------------------ learner
+    policy_step = policy_step0
+    try:
+        for update in range(start_update, num_updates + 1):
+            item = rollout_q.get()
+            if isinstance(item, Exception):
+                raise item
+            data = item["data"]
+            policy_step = item["policy_step"]
+            env_time = item["env_time"]
+
+            clip_coef = cfg.algo.clip_coef
+            ent_coef = cfg.algo.ent_coef
+            if cfg.algo.anneal_clip_coef:
+                clip_coef = polynomial_decay(update, initial=clip_coef, final=0.0, max_decay_steps=num_updates)
+            if cfg.algo.anneal_ent_coef:
+                ent_coef = polynomial_decay(update, initial=ent_coef, final=0.0, max_decay_steps=num_updates)
+
+            with timer("Time/train_time"):
+                t0 = time.perf_counter()
+                params, opt_state, train_metrics = train_fn(params, opt_state, data, ctx.rng(), clip_coef, ent_coef)
+                # Publish the (asynchronously dispatched) params immediately — the
+                # player's next rollout overlaps this update's device execution.
+                param_q.put(params)
+                train_metrics = jax.device_get(train_metrics)
+                train_time = time.perf_counter() - t0
+            with agg_lock:
+                for k, v in train_metrics.items():
+                    aggregator.update(k, float(v))
+
+            if logger is not None and (
+                policy_step - last_log >= cfg.metric.log_every or update == num_updates or cfg.dry_run
+            ):
+                with agg_lock:
+                    metrics = aggregator.compute()
+                    aggregator.reset()
+                metrics["Time/sps_train"] = grad_steps_per_update / train_time if train_time > 0 else 0.0
+                metrics["Time/sps_env_interaction"] = (
+                    policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
+                )
+                logger.log_metrics(metrics, policy_step)
+                last_log = policy_step
+
+            if (
+                cfg.checkpoint.every > 0
+                and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+                or update == num_updates
+                and cfg.checkpoint.save_last
+            ):
+                ckpt_manager.save(
+                    policy_step,
+                    {
+                        "params": params,
+                        "opt_state": opt_state,
+                        "update": update,
+                        "policy_step": policy_step,
+                        "last_log": last_log,
+                        "last_checkpoint": policy_step,
+                    },
+                )
+                last_checkpoint = policy_step
+    finally:
+        stop.set()
+        player_thread.join(timeout=30)
+
+    envs.close()
+    if cfg.algo.run_test and ctx.is_global_zero:
+        reward = test(agent, params, ctx, cfg, log_dir)
+        if logger is not None:
+            logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if logger is not None:
+        logger.close()

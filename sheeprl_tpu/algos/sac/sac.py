@@ -34,63 +34,17 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio
 
 
-@register_algorithm(name="sac")
-def main(ctx, cfg) -> None:
-    rank = ctx.process_index
-    log_dir = get_log_dir(cfg)
-    if ctx.is_global_zero:
-        save_config(cfg, Path(log_dir) / "config.yaml")
-    logger = get_logger(cfg, log_dir)
-
-    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
-    obs_space = envs.single_observation_space
-    act_space = envs.single_action_space
-    mlp_keys = list(cfg.algo.mlp_keys.encoder)
-    act_low, act_high = act_space.low, act_space.high
-    rescale = np.isfinite(act_low).all() and np.isfinite(act_high).all()
-
-    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+def make_sac_train_fn(actor, critic, cfg, act_space):
+    """Optimizers + the jitted scanned SAC update; shared by the coupled and
+    decoupled entry points."""
     act_dim = int(np.prod(act_space.shape))
     target_entropy = -act_dim
+    tau = cfg.algo.tau
+    gamma = cfg.algo.gamma
 
     actor_opt = make_optimizer(cfg.algo.actor.optimizer, cfg.algo.get("max_grad_norm", 0.0))
     critic_opt = make_optimizer(cfg.algo.critic.optimizer, cfg.algo.get("max_grad_norm", 0.0))
     alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
-    opt_state = ctx.replicate(
-        {
-            "actor": actor_opt.init(params["actor"]),
-            "critic": critic_opt.init(params["critic"]),
-            "alpha": alpha_opt.init(params["log_alpha"]),
-        }
-    )
-
-    num_envs = cfg.env.num_envs
-    world = jax.process_count()
-    # Per-env row count: total capacity is cfg.buffer.size transitions across all envs
-    # and ranks (reference sac.py:183).
-    rb = ReplayBuffer(
-        max(int(cfg.buffer.size) // max(num_envs * world, 1), 1),
-        num_envs,
-        obs_keys=mlp_keys,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
-    )
-    rb.seed(cfg.seed + rank)
-
-    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
-    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
-    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
-    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
-
-    tau = cfg.algo.tau
-    gamma = cfg.algo.gamma
-    batch_size = cfg.algo.per_rank_batch_size
-
-    @jax.jit
-    def act_fn(p, obs, key):
-        mean, log_std = actor.apply(p, obs)
-        dist = actor.dist(mean, log_std)
-        return dist.sample(key)
 
     def _losses(p, batch, key):
         key_next, key_new = jax.random.split(key)
@@ -170,6 +124,60 @@ def main(ctx, cfg) -> None:
         batches["_key"] = jax.random.split(key, g)
         (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, grad_step0), batches)
         return p, o_state, jax.tree.map(jnp.mean, metrics)
+
+    return actor_opt, critic_opt, alpha_opt, train_fn
+
+
+@register_algorithm(name="sac")
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    act_low, act_high = act_space.low, act_space.high
+    rescale = np.isfinite(act_low).all() and np.isfinite(act_high).all()
+
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
+    opt_state = ctx.replicate(
+        {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        }
+    )
+
+    num_envs = cfg.env.num_envs
+    world = jax.process_count()
+    # Per-env row count: total capacity is cfg.buffer.size transitions across all envs
+    # and ranks (reference sac.py:183).
+    rb = ReplayBuffer(
+        max(int(cfg.buffer.size) // max(num_envs * world, 1), 1),
+        num_envs,
+        obs_keys=mlp_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+
+    batch_size = cfg.algo.per_rank_batch_size
+
+    @jax.jit
+    def act_fn(p, obs, key):
+        mean, log_std = actor.apply(p, obs)
+        dist = actor.dist(mean, log_std)
+        return dist.sample(key)
 
     # ------------------------------------------------------------------ counters
     policy_steps_per_iter = num_envs * world

@@ -1,0 +1,307 @@
+"""Decoupled SAC — player/learner split (reference: ``/root/reference/sheeprl/algos/sac/sac_decoupled.py``).
+
+Same TPU-native redesign as ``ppo_decoupled``: the reference's rank-0 player +
+DDP-trainer-ranks protocol over torch collectives (``sac_decoupled.py:33,356,547``)
+becomes two threads in the single-controller JAX process.
+
+* **player**: steps the envs, owns the replay buffer, and — once the replay-ratio
+  governor grants gradient steps — samples the ``[G, B, ...]`` batch block and queues it
+  (the analogue of the reference's data scatter);
+* **learner**: consumes the block, runs the scanned SAC update jitted over the mesh
+  (batch sharded on the ``data`` axis), and publishes fresh params back;
+* the player keeps acting with its latest received params while the learner's update is
+  in flight, so env stepping and device compute overlap.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.ppo import make_optimizer
+from sheeprl_tpu.algos.sac.agent import build_agent
+from sheeprl_tpu.algos.sac.sac import make_sac_train_fn
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.config.core import save_config
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_vector_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio
+
+
+@register_algorithm(name="sac_decoupled", decoupled=True)
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    act_low, act_high = act_space.low, act_space.high
+    rescale = np.isfinite(act_low).all() and np.isfinite(act_high).all()
+
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
+    opt_state = ctx.replicate(
+        {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        }
+    )
+
+    num_envs = cfg.env.num_envs
+    world = jax.process_count()
+    rb = ReplayBuffer(
+        max(int(cfg.buffer.size) // max(num_envs * world, 1), 1),
+        num_envs,
+        obs_keys=mlp_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    # Written by the player (episode stats) and read/reset by the learner.
+    agg_lock = threading.Lock()
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    batch_size = cfg.algo.per_rank_batch_size
+
+    @jax.jit
+    def act_fn(p, obs, key):
+        mean, log_std = actor.apply(p, obs)
+        dist = actor.dist(mean, log_std)
+        return dist.sample(key)
+
+    policy_steps_per_iter = num_envs * world
+    total_steps = int(cfg.algo.total_steps)
+    num_iters = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_iters = max(learning_starts - 1, 0)
+
+    start_iter = 1
+    policy_step0 = 0
+    last_log = 0
+    last_checkpoint = 0
+    cumulative_grad_steps = 0
+    if cfg.checkpoint.get("resume_from"):
+        state = CheckpointManager.load(
+            cfg.checkpoint.resume_from,
+            templates={"params": jax.device_get(params), "opt_state": jax.device_get(opt_state)},
+        )
+        params = ctx.replicate(state["params"])
+        opt_state = ctx.replicate(state["opt_state"])
+        ratio.load_state_dict(state["ratio"])
+        start_iter = state["iter_num"] + 1
+        policy_step0 = state["policy_step"]
+        last_log = state.get("last_log", 0)
+        last_checkpoint = state.get("last_checkpoint", 0)
+        cumulative_grad_steps = state.get("cumulative_grad_steps", 0)
+        learning_starts += start_iter
+        if cfg.buffer.checkpoint and "rb" in state:
+            rb.load_state_dict(state["rb"])
+
+    # ------------------------------------------------------------------ roles
+    batch_q: "queue.Queue[Any]" = queue.Queue(maxsize=2)
+    param_q: "queue.Queue[Any]" = queue.Queue(maxsize=2)
+    stop = threading.Event()
+
+    def player() -> None:
+        """Env + buffer role (reference ``player()``, ``sac_decoupled.py:33-…``)."""
+        key = jax.random.PRNGKey(cfg.seed + 10_000 + rank)
+        local_params = params
+        policy_step = policy_step0
+        last_ckpt = last_checkpoint
+        try:
+            obs, _ = envs.reset(seed=cfg.seed + rank)
+            step_data: Dict[str, np.ndarray] = {}
+            for iter_num in range(start_iter, num_iters + 1):
+                if stop.is_set():
+                    return
+                # Pick up the freshest published params without blocking.
+                try:
+                    while True:
+                        local_params = param_q.get_nowait()
+                except queue.Empty:
+                    pass
+                env_t0 = time.perf_counter()
+                with timer("Time/env_interaction_time"):
+                    if iter_num <= learning_starts and not cfg.checkpoint.get("resume_from"):
+                        actions = np.stack([act_space.sample() for _ in range(num_envs)])
+                        tanh_actions = (
+                            2 * (actions - act_low) / (act_high - act_low) - 1 if rescale else actions
+                        )
+                    else:
+                        key, sub = jax.random.split(key)
+                        obs_t = prepare_obs(obs, mlp_keys)
+                        tanh_actions = np.asarray(jax.device_get(act_fn(local_params["actor"], obs_t, sub)))
+                        actions = (
+                            act_low + (tanh_actions + 1) * 0.5 * (act_high - act_low) if rescale else tanh_actions
+                        )
+                    next_obs, reward, terminated, truncated, info = envs.step(actions)
+                    done = np.logical_or(terminated, truncated)
+
+                    real_next = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
+                    if done.any() and "final_obs" in info:
+                        for i in np.nonzero(done)[0]:
+                            if info["final_obs"][i] is not None:
+                                for k in mlp_keys:
+                                    real_next[k][i] = np.asarray(info["final_obs"][i][k])
+
+                    for k in mlp_keys:
+                        step_data[k] = np.asarray(obs[k])[None]
+                        step_data[f"next_{k}"] = real_next[k][None]
+                    step_data["actions"] = tanh_actions.astype(np.float32)[None]
+                    step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
+                    step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                    obs = next_obs
+                    policy_step += policy_steps_per_iter
+                    with agg_lock:
+                        record_episode_stats(aggregator, info)
+                env_time = time.perf_counter() - env_t0
+
+                grad_steps = 0
+                batches = None
+                if iter_num >= learning_starts:
+                    grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
+                    if grad_steps > 0:
+                        sample = rb.sample(batch_size * grad_steps)
+                        batches = {
+                            "obs": np.concatenate(
+                                [sample[k].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1
+                            ),
+                            "next_obs": np.concatenate(
+                                [sample[f"next_{k}"].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1
+                            ),
+                            "actions": sample["actions"].reshape(grad_steps, batch_size, -1),
+                            "rewards": sample["rewards"].reshape(grad_steps, batch_size, 1),
+                            "dones": sample["dones"].reshape(grad_steps, batch_size, 1),
+                        }
+                # rb and ratio live in this thread; snapshot them coherently when a
+                # checkpoint is due so the learner never reads them mid-mutation.
+                ckpt_state = None
+                if (
+                    cfg.checkpoint.every > 0
+                    and (policy_step - last_ckpt) >= cfg.checkpoint.every
+                    or iter_num == num_iters
+                    and cfg.checkpoint.save_last
+                ):
+                    ckpt_state = {"ratio": ratio.state_dict()}
+                    if cfg.buffer.checkpoint:
+                        ckpt_state["rb"] = rb.state_dict()
+                    last_ckpt = policy_step
+                item = {
+                    "iter_num": iter_num,
+                    "batches": batches,
+                    "grad_steps": grad_steps,
+                    "policy_step": policy_step,
+                    "env_time": env_time,
+                    "ckpt": ckpt_state,
+                }
+                while not stop.is_set():
+                    try:
+                        batch_q.put(item, timeout=1.0)
+                        break
+                    except queue.Full:
+                        continue
+        except Exception as exc:
+            batch_q.put(exc)
+
+    player_thread = threading.Thread(target=player, name="sac-player", daemon=True)
+    player_thread.start()
+
+    # ------------------------------------------------------------------ learner
+    policy_step = policy_step0
+    try:
+        for iter_num in range(start_iter, num_iters + 1):
+            item = batch_q.get()
+            if isinstance(item, Exception):
+                raise item
+            policy_step = item["policy_step"]
+            env_time = item["env_time"]
+            grad_steps = item["grad_steps"]
+
+            train_time = 0.0
+            if grad_steps > 0:
+                batches = ctx.put_batch(item["batches"], batch_axis=1)
+                with timer("Time/train_time"):
+                    t0 = time.perf_counter()
+                    params, opt_state, train_metrics = train_fn(
+                        params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+                    )
+                    # Publish the (asynchronously dispatched) params immediately;
+                    # drop stale entries — the player only wants the latest.
+                    try:
+                        param_q.get_nowait()
+                    except queue.Empty:
+                        pass
+                    param_q.put(params)
+                    train_metrics = jax.device_get(train_metrics)
+                    train_time = time.perf_counter() - t0
+                cumulative_grad_steps += grad_steps
+                with agg_lock:
+                    for k, v in train_metrics.items():
+                        aggregator.update(k, float(v))
+
+            if logger is not None and (
+                policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
+            ):
+                with agg_lock:
+                    metrics = aggregator.compute()
+                    aggregator.reset()
+                if train_time > 0:
+                    metrics["Time/sps_train"] = grad_steps / train_time
+                metrics["Time/sps_env_interaction"] = (
+                    policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
+                )
+                metrics["Params/replay_ratio"] = (
+                    cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
+                )
+                logger.log_metrics(metrics, policy_step)
+                last_log = policy_step
+
+            if item["ckpt"] is not None:
+                state = {
+                    "params": params,
+                    "opt_state": opt_state,
+                    "ratio": item["ckpt"]["ratio"],
+                    "iter_num": iter_num,
+                    "policy_step": policy_step,
+                    "last_log": last_log,
+                    "last_checkpoint": policy_step,
+                    "cumulative_grad_steps": cumulative_grad_steps,
+                }
+                if "rb" in item["ckpt"]:
+                    state["rb"] = item["ckpt"]["rb"]
+                ckpt_manager.save(policy_step, state)
+                last_checkpoint = policy_step
+    finally:
+        stop.set()
+        player_thread.join(timeout=30)
+
+    envs.close()
+    if cfg.algo.run_test and ctx.is_global_zero:
+        reward = test(actor, params, ctx, cfg, log_dir)
+        if logger is not None:
+            logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if logger is not None:
+        logger.close()

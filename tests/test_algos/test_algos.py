@@ -322,3 +322,46 @@ def test_p2e_dv12_exploration_and_finetuning(tmp_path, base):
     fntn_ckpts = _ckpts(tmp_path)
     assert len(fntn_ckpts) > len(ckpts)
     evaluate([f"checkpoint_path={fntn_ckpts[-1]}", "env.capture_video=False"])
+
+
+def test_ppo_decoupled_dummy_env(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(
+        [
+            "exp=ppo_decoupled",
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.total_steps=64",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    ckpts = _ckpts(tmp_path)
+    assert ckpts
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+def test_sac_decoupled_dummy_env(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(
+        [
+            "exp=sac_decoupled",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=8",
+            "algo.learning_starts=4",
+            "algo.total_steps=16",
+            "buffer.size=256",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    ckpts = _ckpts(tmp_path)
+    assert ckpts
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
