@@ -1,24 +1,33 @@
-"""Benchmark: DreamerV3 gradient-steps/sec on the flagship config.
+"""Benchmark: DreamerV3 throughput on the flagship config — train-only AND end-to-end.
 
-Runs the full jitted DreamerV3 train step (world model + actor + critic + EMA + moments)
-on synthetic Atari-100K-shaped data — batch 16 × sequence 64 × 64×64×3 pixels, model
-size S — matching the reference's headline benchmark config
-(BASELINE.md: DreamerV3-S on Atari MsPacman-100K).
+Phase 1 (train-only): the full jitted DreamerV3 train step (world model + actor +
+critic + EMA + moments) on synthetic Atari-100K-shaped data — batch 16 × sequence 64 ×
+64×64×3 pixels, model size S — matching the reference's headline benchmark config
+(BASELINE.md: DreamerV3-S on Atari MsPacman-100K).  Also reports an MFU estimate from
+the compiled step's XLA cost analysis and the chip's peak bf16 FLOP/s.
 
-Baseline: the reference reports 14 h on 1× RTX 3080 for Atari-100K
-(README.md:46-53).  100K frames at action-repeat 4 → 25K policy steps; replay ratio 0.5
-→ ~12.5K gradient steps ⇒ ≈0.25 grad-steps/s end-to-end. Train-only throughput is
-higher; we conservatively estimate the reference's pure train-step rate at ~1.0
-grad-steps/s on its GPU (no absolute number is published — BASELINE.md notes the cell
-is empty).  ``vs_baseline`` is measured/1.0.
+Phase 2 (end-to-end): the REAL training loop (env stepping + replay buffer + async
+prefetch + training + logging) through the CLI on the deterministic dummy env, reporting
+the loop's own ``Time/sps_train`` / ``Time/sps_env_interaction`` plus overall
+policy-steps/s.  Set ``BENCH_E2E=0`` to skip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference reports 14 h on 1× RTX 3080 for Atari-100K (README.md:46-53).
+100K frames at action-repeat 4 → 25K policy steps; replay ratio 0.5 → ~12.5K gradient
+steps ⇒ ≈0.25 grad-steps/s end-to-end.  Train-only throughput is higher; we
+conservatively estimate the reference's pure train-step rate at ~1.0 grad-steps/s on its
+GPU (no absolute number is published — BASELINE.md notes the cell is empty).
+``vs_baseline`` is measured/1.0.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import shutil
+import tempfile
 import time
 
 import numpy as np
@@ -27,15 +36,37 @@ os.environ.setdefault("SHEEPRL_TPU_QUIET", "1")
 
 BASELINE_GRAD_STEPS_PER_SEC = 1.0  # estimated reference 1-GPU train-only rate (see above)
 
+# Peak dense bf16 FLOP/s per chip (public figures).
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12 / 2,  # per-chip figure is per 2 cores; one jax device = 1 chip
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+}
 
-def main() -> None:
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for name, peak in PEAK_FLOPS.items():
+        if kind.startswith(name):
+            return peak
+    return 275e12  # assume v4 when unknown
+
+
+def bench_train_only():
     import jax
     import jax.numpy as jnp
 
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
     from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
     from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
     from sheeprl_tpu.config.core import compose
     from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
+
+    import gymnasium as gym
 
     cfg = compose(
         overrides=[
@@ -49,10 +80,6 @@ def main() -> None:
     cfg.algo.mlp_keys.encoder = []
 
     ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="bf16-mixed", seed=0)
-
-    import gymnasium as gym
-
-    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
 
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
     actions_dim = (6,)
@@ -75,6 +102,17 @@ def main() -> None:
     key = jax.random.PRNGKey(0)
     update_target = jnp.asarray(True)
 
+    # FLOPs of one compiled step (XLA's own estimate) for the MFU figure.
+    flops_per_step = 0.0
+    try:
+        compiled = train_jit.lower(params, opt_states, moments, data, key, update_target).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops_per_step = float(cost.get("flops", 0.0))
+    except Exception:
+        pass
+
     # Warmup (compile + a few steps); device_get forces a full host-visible sync —
     # block_until_ready alone has proven unreliable on the axon transport.
     metrics = None
@@ -92,6 +130,74 @@ def main() -> None:
     elapsed = time.perf_counter() - t0
 
     gsps = n_steps / elapsed
+    mfu = 0.0
+    if flops_per_step > 0:
+        mfu = flops_per_step * gsps / _peak_flops(jax.devices()[0])
+    return gsps, mfu
+
+
+def bench_e2e():
+    """Real training loop (env + buffer + prefetch + train) on the dummy env."""
+    from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+
+    from sheeprl_tpu.cli import run
+
+    tmp = tempfile.mkdtemp(prefix="bench_e2e_")
+    total_steps = int(os.environ.get("BENCH_E2E_STEPS", "768"))
+    t0 = time.perf_counter()
+    try:
+        run(
+            [
+                "exp=dreamer_v3_dummy",
+                "algo=dreamer_v3_S",
+                "env=discrete_dummy",
+                "algo.cnn_keys.encoder=[rgb]",
+                "algo.mlp_keys.encoder=[]",
+                "env.screen_size=64",
+                "env.num_envs=4",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                f"algo.total_steps={total_steps}",
+                "algo.learning_starts=256",
+                "algo.replay_ratio=1",
+                "algo.per_rank_batch_size=16",
+                "algo.per_rank_sequence_length=64",
+                "algo.run_test=False",
+                "buffer.size=100000",
+                "buffer.memmap=False",
+                "buffer.checkpoint=False",
+                "checkpoint.every=0",
+                "checkpoint.save_last=False",
+                "metric.log_every=1",
+                f"log_root={tmp}",
+            ]
+        )
+        elapsed = time.perf_counter() - t0
+        out = {"e2e_policy_steps_per_sec": round(total_steps / elapsed, 3)}
+        runs = sorted(glob.glob(os.path.join(tmp, "**", "version_*"), recursive=True))
+        if runs:
+            ea = EventAccumulator(runs[-1])
+            ea.Reload()
+            for tag, key in (
+                ("Time/sps_train", "e2e_sps_train"),
+                ("Time/sps_env_interaction", "e2e_sps_env_interaction"),
+            ):
+                if tag in ea.Tags()["scalars"]:
+                    vals = [s.value for s in ea.Scalars(tag)]
+                    out[key] = round(float(np.mean(vals)), 3)
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> None:
+    gsps, mfu = bench_train_only()
+    extras = {}
+    if os.environ.get("BENCH_E2E", "1") != "0":
+        try:
+            extras = bench_e2e()
+        except Exception as exc:  # the headline number must still print
+            extras = {"e2e_error": str(exc)[:200]}
     print(
         json.dumps(
             {
@@ -99,6 +205,8 @@ def main() -> None:
                 "value": round(gsps, 4),
                 "unit": "grad_steps/s (batch 16 x seq 64, 64x64x3 obs, 1 chip)",
                 "vs_baseline": round(gsps / BASELINE_GRAD_STEPS_PER_SEC, 4),
+                "mfu": round(mfu, 4),
+                **extras,
             }
         )
     )

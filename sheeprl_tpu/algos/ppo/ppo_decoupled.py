@@ -34,9 +34,7 @@ from pathlib import Path
 from typing import Any, Dict
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import optax
 
 from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
@@ -49,7 +47,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, polynomial_decay
+from sheeprl_tpu.utils.utils import polynomial_decay
 
 
 @register_algorithm(name="ppo_decoupled", decoupled=True)
@@ -253,6 +251,12 @@ def main(ctx, cfg) -> None:
                 metrics["Time/sps_env_interaction"] = (
                     policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
                 )
+                grad_step_count = update * grad_steps_per_update
+                metrics["Params/lr"] = (
+                    float(fns.lr_schedule(grad_step_count))
+                    if fns.lr_schedule is not None
+                    else float(cfg.algo.optimizer.lr)
+                )
                 logger.log_metrics(metrics, policy_step)
                 last_log = policy_step
 
@@ -278,6 +282,10 @@ def main(ctx, cfg) -> None:
         stop.set()
         player_thread.join(timeout=30)
 
+    if player_thread.is_alive():
+        # The player is stuck inside envs.step(); closing the envs under it would
+        # raise a secondary error that masks the original one.
+        raise RuntimeError("decoupled player thread did not shut down cleanly")
     envs.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(agent, params, ctx, cfg, log_dir)

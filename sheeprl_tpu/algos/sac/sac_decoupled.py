@@ -26,7 +26,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.sac.agent import build_agent
 from sheeprl_tpu.algos.sac.sac import make_sac_train_fn
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
@@ -298,6 +297,8 @@ def main(ctx, cfg) -> None:
         stop.set()
         player_thread.join(timeout=30)
 
+    if player_thread.is_alive():
+        raise RuntimeError("decoupled player thread did not shut down cleanly")
     envs.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(actor, params, ctx, cfg, log_dir)

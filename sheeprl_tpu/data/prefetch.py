@@ -1,0 +1,78 @@
+"""Async host→device batch prefetch (SURVEY §7 "hard parts": host env stepping can
+starve the TPU; double-buffer the sampled batches so the device never waits on
+host-side replay sampling + transfer).
+
+``AsyncBatchPrefetcher`` keeps ONE sample request in flight on a worker thread: while
+the accelerator executes the current block of gradient steps, the worker draws the next
+``[n_samples, T, B, ...]`` block from the replay buffer and ships it to the device
+(sharded, when a sharding is given).  ``get(n)`` returns the staged block when its shape
+matches and immediately queues the next one.
+
+Coherency: the worker samples under ``self.lock``; training loops must wrap their
+``rb.add(...)`` calls with the same lock so the worker never reads a row mid-write.
+The staged block is sampled one iteration early — with replay buffers of ≥10⁴
+transitions the one-step staleness of the sampling distribution is negligible (the
+data itself is identical; only the newest iteration's rows are excluded).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+
+class AsyncBatchPrefetcher:
+    def __init__(self, sample_fn: Callable[[int], Any]):
+        self.lock = threading.Lock()
+        self._sample_fn = sample_fn
+        self._req: "queue.Queue[Optional[int]]" = queue.Queue(maxsize=1)
+        self._res: "queue.Queue[Any]" = queue.Queue(maxsize=1)
+        self._pending_n: Optional[int] = None
+        self._thread = threading.Thread(target=self._work, name="batch-prefetch", daemon=True)
+        self._thread.start()
+
+    def _work(self) -> None:
+        while True:
+            n = self._req.get()
+            if n is None:
+                return
+            try:
+                with self.lock:
+                    block = self._sample_fn(n)
+            except Exception as exc:  # surfaced on the consumer's next get()
+                block = exc
+            self._res.put(block)
+
+    def get(self, n: int, stage_next: bool = True) -> Any:
+        """Return an ``n``-sample block; staged if the in-flight request matches,
+        sampled synchronously otherwise (e.g. when the Ratio governor changes n).
+        Pass ``stage_next=False`` on the final iteration so no discarded block is
+        sampled/transferred after the run ends."""
+        if self._pending_n == n:
+            block = self._res.get()
+            self._pending_n = None
+            if isinstance(block, Exception):
+                raise block
+        else:
+            if self._pending_n is not None:
+                self._res.get()  # drain the mismatched in-flight block
+                self._pending_n = None
+            with self.lock:
+                block = self._sample_fn(n)
+        if stage_next:
+            self._req.put(n)
+            self._pending_n = n
+        return block
+
+    def close(self) -> None:
+        if self._pending_n is not None:
+            try:
+                self._res.get(timeout=10)
+            except queue.Empty:
+                pass
+            self._pending_n = None
+        try:
+            self._req.put_nowait(None)
+        except queue.Full:
+            pass

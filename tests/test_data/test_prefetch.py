@@ -1,0 +1,61 @@
+"""AsyncBatchPrefetcher unit tests."""
+
+import numpy as np
+
+from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+
+
+def test_prefetcher_matches_synchronous_sampler():
+    calls = []
+
+    def sample(n):
+        calls.append(n)
+        return np.full((n, 2), len(calls))
+
+    pf = AsyncBatchPrefetcher(sample)
+    a = pf.get(3)  # no staged block: synchronous
+    assert a.shape == (3, 2)
+    b = pf.get(3)  # staged block from the background request
+    assert b.shape == (3, 2)
+    c = pf.get(5)  # size change: staged block drained, fresh synchronous sample
+    assert c.shape == (5, 2)
+    pf.close()
+
+
+def test_prefetcher_propagates_worker_exceptions():
+    state = {"fail": False}
+
+    def sample(n):
+        if state["fail"]:
+            raise RuntimeError("boom")
+        return np.zeros((n, 1))
+
+    pf = AsyncBatchPrefetcher(sample)
+    pf.get(2)
+    state["fail"] = True  # the staged request for the NEXT get(2) will fail
+    try:
+        pf.get(2)
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised
+    state["fail"] = False
+    pf.close()
+
+
+def test_prefetcher_lock_guards_buffer_writes():
+    import threading
+    import time as _time
+
+    writes = []
+
+    def sample(n):
+        _time.sleep(0.05)
+        return list(writes)
+
+    pf = AsyncBatchPrefetcher(sample)
+    pf.get(1)  # stages a background sample holding the lock for 50ms
+    with pf.lock:
+        writes.append(1)
+    assert pf.get(1) is not None
+    pf.close()
