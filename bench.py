@@ -41,9 +41,10 @@ PEAK_FLOPS = {
     "TPU v2": 45e12,
     "TPU v3": 123e12 / 2,  # per-chip figure is per 2 cores; one jax device = 1 chip
     "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
+    "TPU v5 lite": 197e12,  # v5e's device_kind
     "TPU v5e": 197e12,
     "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e/Trillium's device_kind
     "TPU v6e": 918e12,
 }
 

@@ -420,161 +420,163 @@ def main(ctx, cfg) -> None:
     is_first_np = np.ones((num_envs, 1), dtype=np.float32)
     prefill_iters = max(learning_starts - 1, 0)
 
-    for iter_num in range(start_iter, num_iters + 1):
-        env_t0 = time.perf_counter()
-        with timer("Time/env_interaction_time"):
-            if iter_num <= learning_starts and not cfg.checkpoint.get("resume_from"):
-                if is_continuous:
-                    stored_actions = np.stack([act_space.sample() for _ in range(num_envs)]).astype(np.float32)
-                    env_actions = stored_actions
+    try:
+        for iter_num in range(start_iter, num_iters + 1):
+            env_t0 = time.perf_counter()
+            with timer("Time/env_interaction_time"):
+                if iter_num <= learning_starts and not cfg.checkpoint.get("resume_from"):
+                    if is_continuous:
+                        stored_actions = np.stack([act_space.sample() for _ in range(num_envs)]).astype(np.float32)
+                        env_actions = stored_actions
+                    else:
+                        sampled = np.stack([act_space.sample() for _ in range(num_envs)])
+                        sampled = sampled.reshape(num_envs, -1)
+                        onehots = []
+                        for i, d in enumerate(actions_dim):
+                            oh = np.zeros((num_envs, d), dtype=np.float32)
+                            oh[np.arange(num_envs), sampled[:, i]] = 1.0
+                            onehots.append(oh)
+                        stored_actions = np.concatenate(onehots, -1)
+                        env_actions = sampled.squeeze(-1) if len(actions_dim) == 1 else sampled
+                    # keep the player state in sync with the executed action
+                    player_state = player_state._replace(actions=jnp.asarray(stored_actions))
                 else:
-                    sampled = np.stack([act_space.sample() for _ in range(num_envs)])
-                    sampled = sampled.reshape(num_envs, -1)
-                    onehots = []
-                    for i, d in enumerate(actions_dim):
-                        oh = np.zeros((num_envs, d), dtype=np.float32)
-                        oh[np.arange(num_envs), sampled[:, i]] = 1.0
-                        onehots.append(oh)
-                    stored_actions = np.concatenate(onehots, -1)
-                    env_actions = sampled.squeeze(-1) if len(actions_dim) == 1 else sampled
-                # keep the player state in sync with the executed action
-                player_state = player_state._replace(actions=jnp.asarray(stored_actions))
-            else:
-                obs_t = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
-                actions, stored, player_state = player_jit(
-                    params, player_state, obs_t, jnp.asarray(is_first_np), ctx.rng()
-                )
-                stored_actions = np.asarray(jax.device_get(stored))
-                acts_np = [np.asarray(jax.device_get(a)) for a in actions]
-                if is_continuous:
-                    env_actions = acts_np[0]
-                elif len(actions_dim) == 1:
-                    env_actions = acts_np[0].argmax(-1)
-                else:
-                    env_actions = np.stack([a.argmax(-1) for a in acts_np], -1)
-
-            # Commit the pending row with the action taken from its observation
-            # (under the prefetcher's lock: the sampler thread must not read rows
-            # mid-write).
-            step_data["actions"] = stored_actions.reshape(1, num_envs, -1)
-            with rb_lock:
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
-            next_obs, reward, terminated, truncated, info = envs.step(env_actions)
-            if cfg.env.clip_rewards:
-                reward = np.clip(reward, -1, 1)
-            done = np.logical_or(terminated, truncated)
-            reward = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)
-
-            # True final observation for done envs (SAME_STEP autoreset returns the
-            # reset obs; the final one lives in info["final_obs"]).
-            real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
-            if done.any() and "final_obs" in info:
-                for i in np.nonzero(done)[0]:
-                    if info["final_obs"][i] is not None:
-                        for k in obs_keys:
-                            real_next_obs[k][i] = np.asarray(info["final_obs"][i][k])
-
-            # Build the next pending row: obs_{t+1} + arrival reward/flags.
-            step_data = _obs_row(next_obs)
-            step_data["rewards"] = reward.reshape(1, num_envs, 1).copy()
-            step_data["terminated"] = terminated.astype(np.float32).reshape(1, num_envs, 1)
-            step_data["truncated"] = truncated.astype(np.float32).reshape(1, num_envs, 1)
-            step_data["is_first"] = np.zeros((1, num_envs, 1), np.float32)
-
-            done_idxs = np.nonzero(done)[0].tolist()
-            if done_idxs:
-                # Terminal row: final obs + arrival reward/flags + zero action.
-                reset_data = _obs_row(real_next_obs, idxs=done_idxs)
-                reset_data["rewards"] = step_data["rewards"][:, done_idxs]
-                reset_data["terminated"] = step_data["terminated"][:, done_idxs]
-                reset_data["truncated"] = step_data["truncated"][:, done_idxs]
-                reset_data["actions"] = np.zeros((1, len(done_idxs), act_dim_sum), np.float32)
-                reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-                with rb_lock:
-                    rb.add(reset_data, indices=done_idxs, validate_args=cfg.buffer.validate_args)
-                # The pending row for reset envs starts a fresh episode.
-                step_data["rewards"][:, done_idxs] = 0.0
-                step_data["terminated"][:, done_idxs] = 0.0
-                step_data["truncated"][:, done_idxs] = 0.0
-                step_data["is_first"][:, done_idxs] = 1.0
-
-            is_first_np = done.astype(np.float32).reshape(num_envs, 1)
-            obs = next_obs
-            policy_step += policy_steps_per_iter
-            record_episode_stats(aggregator, info)
-        env_time = time.perf_counter() - env_t0
-
-        train_time = 0.0
-        grad_steps = 0
-        if iter_num >= learning_starts:
-            grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
-            if grad_steps > 0:
-                with timer("Time/train_time"):
-                    t0 = time.perf_counter()
-                    # [n_samples, T, B, ...] with B sharded over the data axis: the
-                    # jitted step then runs data-parallel with GSPMD gradient psums
-                    # (falls back to replication when B doesn't divide the mesh).
-                    sample = (
-                        prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
-                        if prefetcher is not None
-                        else _sample_block(grad_steps)
+                    obs_t = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
+                    actions, stored, player_state = player_jit(
+                        params, player_state, obs_t, jnp.asarray(is_first_np), ctx.rng()
                     )
-                    for g in range(grad_steps):
-                        batch = {k: v[g] for k, v in sample.items()}
-                        cumulative_grad_steps += 1
-                        update_target = jnp.asarray(
-                            cumulative_grad_steps % target_update_freq == 0
+                    stored_actions = np.asarray(jax.device_get(stored))
+                    acts_np = [np.asarray(jax.device_get(a)) for a in actions]
+                    if is_continuous:
+                        env_actions = acts_np[0]
+                    elif len(actions_dim) == 1:
+                        env_actions = acts_np[0].argmax(-1)
+                    else:
+                        env_actions = np.stack([a.argmax(-1) for a in acts_np], -1)
+
+                # Commit the pending row with the action taken from its observation
+                # (under the prefetcher's lock: the sampler thread must not read rows
+                # mid-write).
+                step_data["actions"] = stored_actions.reshape(1, num_envs, -1)
+                with rb_lock:
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                next_obs, reward, terminated, truncated, info = envs.step(env_actions)
+                if cfg.env.clip_rewards:
+                    reward = np.clip(reward, -1, 1)
+                done = np.logical_or(terminated, truncated)
+                reward = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)
+
+                # True final observation for done envs (SAME_STEP autoreset returns the
+                # reset obs; the final one lives in info["final_obs"]).
+                real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+                if done.any() and "final_obs" in info:
+                    for i in np.nonzero(done)[0]:
+                        if info["final_obs"][i] is not None:
+                            for k in obs_keys:
+                                real_next_obs[k][i] = np.asarray(info["final_obs"][i][k])
+
+                # Build the next pending row: obs_{t+1} + arrival reward/flags.
+                step_data = _obs_row(next_obs)
+                step_data["rewards"] = reward.reshape(1, num_envs, 1).copy()
+                step_data["terminated"] = terminated.astype(np.float32).reshape(1, num_envs, 1)
+                step_data["truncated"] = truncated.astype(np.float32).reshape(1, num_envs, 1)
+                step_data["is_first"] = np.zeros((1, num_envs, 1), np.float32)
+
+                done_idxs = np.nonzero(done)[0].tolist()
+                if done_idxs:
+                    # Terminal row: final obs + arrival reward/flags + zero action.
+                    reset_data = _obs_row(real_next_obs, idxs=done_idxs)
+                    reset_data["rewards"] = step_data["rewards"][:, done_idxs]
+                    reset_data["terminated"] = step_data["terminated"][:, done_idxs]
+                    reset_data["truncated"] = step_data["truncated"][:, done_idxs]
+                    reset_data["actions"] = np.zeros((1, len(done_idxs), act_dim_sum), np.float32)
+                    reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+                    with rb_lock:
+                        rb.add(reset_data, indices=done_idxs, validate_args=cfg.buffer.validate_args)
+                    # The pending row for reset envs starts a fresh episode.
+                    step_data["rewards"][:, done_idxs] = 0.0
+                    step_data["terminated"][:, done_idxs] = 0.0
+                    step_data["truncated"][:, done_idxs] = 0.0
+                    step_data["is_first"][:, done_idxs] = 1.0
+
+                is_first_np = done.astype(np.float32).reshape(num_envs, 1)
+                obs = next_obs
+                policy_step += policy_steps_per_iter
+                record_episode_stats(aggregator, info)
+            env_time = time.perf_counter() - env_t0
+
+            train_time = 0.0
+            grad_steps = 0
+            if iter_num >= learning_starts:
+                grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
+                if grad_steps > 0:
+                    with timer("Time/train_time"):
+                        t0 = time.perf_counter()
+                        # [n_samples, T, B, ...] with B sharded over the data axis: the
+                        # jitted step then runs data-parallel with GSPMD gradient psums
+                        # (falls back to replication when B doesn't divide the mesh).
+                        sample = (
+                            prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
+                            if prefetcher is not None
+                            else _sample_block(grad_steps)
                         )
-                        params, opt_states, moments_state, train_metrics = train_jit(
-                            params, opt_states, moments_state, batch, ctx.rng(), update_target
-                        )
-                    train_metrics = jax.device_get(train_metrics)
-                    train_time = time.perf_counter() - t0
-                for k, v in train_metrics.items():
-                    aggregator.update(k, float(v))
+                        for g in range(grad_steps):
+                            batch = {k: v[g] for k, v in sample.items()}
+                            cumulative_grad_steps += 1
+                            update_target = jnp.asarray(
+                                cumulative_grad_steps % target_update_freq == 0
+                            )
+                            params, opt_states, moments_state, train_metrics = train_jit(
+                                params, opt_states, moments_state, batch, ctx.rng(), update_target
+                            )
+                        train_metrics = jax.device_get(train_metrics)
+                        train_time = time.perf_counter() - t0
+                    for k, v in train_metrics.items():
+                        aggregator.update(k, float(v))
 
-        if logger is not None and (
-            policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
-        ):
-            metrics = aggregator.compute()
-            if train_time > 0:
-                metrics["Time/sps_train"] = grad_steps / train_time
-            metrics["Time/sps_env_interaction"] = (
-                policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
-            )
-            metrics["Params/replay_ratio"] = (
-                cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
-            )
-            logger.log_metrics(metrics, policy_step)
-            aggregator.reset()
-            last_log = policy_step
+            if logger is not None and (
+                policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
+            ):
+                metrics = aggregator.compute()
+                if train_time > 0:
+                    metrics["Time/sps_train"] = grad_steps / train_time
+                metrics["Time/sps_env_interaction"] = (
+                    policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
+                )
+                metrics["Params/replay_ratio"] = (
+                    cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
+                )
+                logger.log_metrics(metrics, policy_step)
+                aggregator.reset()
+                last_log = policy_step
 
-        if (
-            cfg.checkpoint.every > 0
-            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
-            or iter_num == num_iters
-            and cfg.checkpoint.save_last
-        ):
-            state = {
-                "params": params,
-                "opt_states": opt_states,
-                "moments": moments_state,
-                "ratio": ratio.state_dict(),
-                "iter_num": iter_num,
-                "policy_step": policy_step,
-                "last_log": last_log,
-                "last_checkpoint": policy_step,
-                "cumulative_grad_steps": cumulative_grad_steps,
-            }
-            if cfg.buffer.checkpoint:
-                state["rb"] = rb.state_dict()
-            ckpt_manager.save(policy_step, state)
-            last_checkpoint = policy_step
+            if (
+                cfg.checkpoint.every > 0
+                and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+                or iter_num == num_iters
+                and cfg.checkpoint.save_last
+            ):
+                state = {
+                    "params": params,
+                    "opt_states": opt_states,
+                    "moments": moments_state,
+                    "ratio": ratio.state_dict(),
+                    "iter_num": iter_num,
+                    "policy_step": policy_step,
+                    "last_log": last_log,
+                    "last_checkpoint": policy_step,
+                    "cumulative_grad_steps": cumulative_grad_steps,
+                }
+                if cfg.buffer.checkpoint:
+                    state["rb"] = rb.state_dict()
+                ckpt_manager.save(policy_step, state)
+                last_checkpoint = policy_step
 
-    envs.close()
-    if prefetcher is not None:
-        prefetcher.close()
+    finally:
+        envs.close()
+        if prefetcher is not None:
+            prefetcher.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(player_step, params, player_state_init, ctx, cfg, log_dir)
         if logger is not None:

@@ -49,14 +49,21 @@ class AsyncBatchPrefetcher:
         sampled synchronously otherwise (e.g. when the Ratio governor changes n).
         Pass ``stage_next=False`` on the final iteration so no discarded block is
         sampled/transferred after the run ends."""
-        if self._pending_n == n:
+        if self._pending_n is not None and self._pending_n >= n:
+            staged_n = self._pending_n
             block = self._res.get()
             self._pending_n = None
             if isinstance(block, Exception):
                 raise block
+            if staged_n > n:
+                # Oscillating Ratio (e.g. 1,2,1,2,...): reuse the staged block's
+                # first n samples instead of discarding the whole transfer.
+                import jax
+
+                block = jax.tree.map(lambda x: x[:n], block)
         else:
             if self._pending_n is not None:
-                self._res.get()  # drain the mismatched in-flight block
+                self._res.get()  # drain the too-small in-flight block
                 self._pending_n = None
             with self.lock:
                 block = self._sample_fn(n)

@@ -1,0 +1,146 @@
+"""Learning-signal tests (VERDICT r1 item 7): smoke tests alone cannot catch a
+sign-flipped advantage or KL — these assert that learning actually HAPPENS.
+
+* PPO on CartPole-v1 must clearly beat a random policy within a small step budget;
+* a Dreamer (V1/V2/V3) world-model loss must strictly decrease when the jitted train
+  step is iterated on a fixed synthetic batch.
+"""
+
+import glob
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+def _tb_scalar(log_root, tag):
+    from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+
+    runs = sorted(glob.glob(f"{log_root}/**/version_*", recursive=True))
+    assert runs, "no run dir written"
+    ea = EventAccumulator(runs[-1])
+    ea.Reload()
+    assert tag in ea.Tags()["scalars"], f"{tag} not logged"
+    return [s.value for s in ea.Scalars(tag)]
+
+
+def test_ppo_cartpole_learns(tmp_path):
+    """Random CartPole policy scores ~20; a correctly-signed PPO must far exceed it."""
+    run(
+        [
+            "exp=ppo",
+            "env=gym",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=128",
+            "algo.per_rank_batch_size=64",
+            "algo.update_epochs=4",
+            "algo.dense_units=64",
+            "algo.mlp_layers=2",
+            "algo.total_steps=20480",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            "metric.log_every=512",
+            f"log_root={tmp_path}",
+            "buffer.memmap=False",
+        ]
+    )
+    test_reward = _tb_scalar(tmp_path, "Test/cumulative_reward")[-1]
+    train_rewards = _tb_scalar(tmp_path, "Rewards/rew_avg")
+    best = max(max(train_rewards), test_reward)
+    assert best >= 100.0, f"PPO failed to learn CartPole: best avg reward {best:.1f} (< 100)"
+
+
+def _world_model_loss_curve(algo: str, steps: int = 25):
+    """Iterate the jitted train step on one synthetic batch; return the WM losses."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.config.core import compose
+    from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
+
+    cfg = compose(
+        overrides=[
+            f"exp={algo}_dummy",
+            "algo.per_rank_batch_size=4",
+            "algo.per_rank_sequence_length=8",
+        ]
+    )
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+    screen = cfg.env.screen_size if algo == "dreamer_v3" else 64
+    obs_space = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (3, screen, screen), np.uint8),
+            "state": gym.spaces.Box(-np.inf, np.inf, (4,), np.float32),
+        }
+    )
+    actions_dim = (3,)
+
+    if algo == "dreamer_v3":
+        from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+        from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+        from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+    elif algo == "dreamer_v2":
+        from sheeprl_tpu.algos.dreamer_v2.agent import build_agent
+        from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import make_train_step
+    else:
+        from sheeprl_tpu.algos.dreamer_v1.agent import build_agent
+        from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import make_train_step
+
+    world_model, actor, critic, params, *_ = build_agent(ctx, actions_dim, False, cfg, obs_space)
+
+    T, B = 8, 4
+    rng = np.random.default_rng(0)
+    # A learnable (low-entropy, structured) synthetic sequence.
+    base = rng.integers(0, 64, (1, 1, 3, screen, screen), dtype=np.uint8)
+    data = {
+        "rgb": jnp.asarray(np.broadcast_to(base, (T, B, 3, screen, screen)).copy()),
+        "state": jnp.asarray(rng.random((T, B, 4)).astype(np.float32)),
+        "actions": jnp.asarray(rng.random((T, B, 3)).astype(np.float32)),
+        "rewards": jnp.ones((T, B, 1), jnp.float32),
+        "terminated": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+
+    losses = []
+    key = jax.random.PRNGKey(0)
+    if algo == "dreamer_v3":
+        train_step, init_opt = make_train_step(world_model, actor, critic, cfg, ["rgb"], ["state"], {})
+        opt_states = init_opt(params)
+        moments = init_moments()
+        train_jit = jax.jit(train_step)
+        for _ in range(steps):
+            key, sub = jax.random.split(key)
+            params, opt_states, moments, metrics = train_jit(
+                params, opt_states, moments, data, sub, jnp.asarray(True)
+            )
+            losses.append(float(metrics["Loss/world_model_loss"]))
+    elif algo == "dreamer_v2":
+        train_step, init_opt = make_train_step(world_model, actor, critic, cfg, ["rgb"], ["state"])
+        opt_states = init_opt(params)
+        train_jit = jax.jit(train_step)
+        for _ in range(steps):
+            key, sub = jax.random.split(key)
+            params, opt_states, metrics = train_jit(params, opt_states, data, sub, jnp.asarray(True))
+            losses.append(float(metrics["Loss/world_model_loss"]))
+    else:
+        train_step, init_opt = make_train_step(world_model, actor, critic, cfg, ["rgb"], ["state"])
+        opt_states = init_opt(params)
+        train_jit = jax.jit(train_step)
+        for _ in range(steps):
+            key, sub = jax.random.split(key)
+            params, opt_states, metrics = train_jit(params, opt_states, data, sub)
+            losses.append(float(metrics["Loss/world_model_loss"]))
+    return losses
+
+
+@pytest.mark.parametrize("algo", ["dreamer_v3", "dreamer_v2", "dreamer_v1"])
+def test_dreamer_world_model_loss_decreases(algo):
+    losses = _world_model_loss_curve(algo)
+    assert np.isfinite(losses).all(), f"non-finite world-model loss: {losses}"
+    first, last = np.mean(losses[:3]), np.mean(losses[-3:])
+    assert last < first, f"{algo} world-model loss did not decrease: {first:.2f} -> {last:.2f}"
