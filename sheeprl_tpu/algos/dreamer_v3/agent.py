@@ -327,6 +327,38 @@ class RSSM(nn.Module):
         return imagined.reshape(*imagined.shape[:-2], -1), recurrent_state
 
 
+class DecoupledRSSM(RSSM):
+    """RSSM whose posterior depends on the observation embedding ALONE
+    (reference ``agent.py:501-593``): ``q(z_t | o_t)`` instead of ``q(z_t | h_t, o_t)``.
+
+    TPU payoff: the posterior for the whole ``[T, B]`` batch is ONE vectorized
+    representation call (no recurrent dependency), so only the prior runs in the
+    ``lax.scan``."""
+
+    def _representation(self, embedded_obs: jax.Array, key: Optional[jax.Array], sample: bool = True):  # type: ignore[override]
+        logits = self.representation_model(embedded_obs).astype(jnp.float32)
+        logits = self._uniform_mix(logits)
+        return logits, compute_stochastic_state(key, logits, self.discrete_size, sample)
+
+    def dynamic(  # type: ignore[override]
+        self,
+        posterior: jax.Array,  # [B, stoch*discrete] — the PREVIOUS step's posterior
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        is_first: jax.Array,
+        key: jax.Array,
+    ):
+        """Prior-only step (reference ``agent.py:542-580``): the posterior is supplied
+        (already computed from the embedding); returns only recurrent state + prior."""
+        action = (1 - is_first) * action
+        h0, z0 = self.get_initial_states(recurrent_state.shape[:-1])
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * h0
+        posterior = (1 - is_first) * posterior + is_first * z0
+        recurrent_state = self.recurrent_model(jnp.concatenate([posterior, action], -1), recurrent_state)
+        prior_logits, prior = self._transition(recurrent_state, key)
+        return recurrent_state, prior, prior_logits
+
+
 class WorldModel(nn.Module):
     """Encoder + RSSM + decoders + reward/continue heads under one params tree
     (one optimizer, reference ``agent.py:707`` WorldModel wrapper)."""
@@ -347,6 +379,7 @@ class WorldModel(nn.Module):
     reward_bins: int = 255
     image_size: int = 64
     learnable_initial_recurrent_state: bool = True
+    decoupled_rssm: bool = False
     dtype: Dtype = jnp.float32
 
     def setup(self):
@@ -358,7 +391,8 @@ class WorldModel(nn.Module):
             mlp_layers=self.mlp_layers,
             dtype=self.dtype,
         )
-        self.rssm = RSSM(
+        rssm_cls = DecoupledRSSM if self.decoupled_rssm else RSSM
+        self.rssm = rssm_cls(
             stochastic_size=self.stochastic_size,
             discrete_size=self.discrete_size,
             recurrent_state_size=self.recurrent_state_size,
@@ -436,16 +470,27 @@ class WorldModel(nn.Module):
         return self.rssm.get_initial_states(batch_shape)
 
     def representation(self, recurrent_state, embedded_obs, key, sample=True):
+        if self.decoupled_rssm:
+            return self.rssm._representation(embedded_obs, key, sample)
         return self.rssm._representation(recurrent_state, embedded_obs, key, sample)
 
+    def representation_from_embed(self, embedded_obs, key, sample=True):
+        """Vectorized posterior over a whole [T, B] batch (DecoupledRSSM only)."""
+        return self.rssm._representation(embedded_obs, key, sample)
+
     def __call__(self, obs: Dict[str, jax.Array], action: jax.Array, key: jax.Array):
-        """Init path: touch every submodule once."""
+        """Init path: touch every submodule once (both RSSM variants)."""
         embed = self.encoder(obs)
         batch_shape = embed.shape[:-1]
         h0, z0 = self.rssm.get_initial_states(batch_shape)
-        h, z, prior, post_logits, prior_logits = self.rssm.dynamic(
-            z0, h0, action, embed, jnp.ones((*batch_shape, 1)), key
-        )
+        if self.decoupled_rssm:
+            _, post = self.rssm._representation(embed, key)
+            z = post.reshape(*post.shape[:-2], -1)
+            h, prior, prior_logits = self.rssm.dynamic(z0, h0, action, jnp.ones((*batch_shape, 1)), key)
+        else:
+            h, z, prior, post_logits, prior_logits = self.rssm.dynamic(
+                z0, h0, action, embed, jnp.ones((*batch_shape, 1)), key
+            )
         latent = jnp.concatenate([z, h], -1)
         recon = self.decode(latent)
         return self.reward(latent), self.continues(latent), recon
@@ -467,7 +512,7 @@ class DreamerActor(nn.Module):
     dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False):
+    def __call__(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False, mask=None):
         dist_type = self.distribution
         if dist_type == "auto":
             dist_type = "scaled_normal" if self.is_continuous else "discrete"
@@ -511,6 +556,68 @@ class DreamerActor(nn.Module):
             d = OneHotCategoricalStraightThrough(unimix_logits(logits, self.unimix))
             dists.append(d)
             actions.append(d.mode if (greedy or k is None) else d.rsample(k))
+        return tuple(actions), tuple(dists)
+
+
+class MinedojoActor(nn.Module):
+    """Hierarchical masked actor for MineDojo (reference ``agent.py:848-932``).
+
+    Three discrete heads — (action-type, craft-arg, item-arg) — sampled in order: the
+    craft/item heads are masked *conditionally on the sampled action-type* (craft-arg
+    only constrains when action 15 is chosen; item-arg when 16/17 equip/place or 18
+    destroy is chosen).  The reference masks with a python double loop over [T, B];
+    here the conditional masks are vectorized ``jnp.where`` selects."""
+
+    actions_dim: Sequence[int]  # (len(ACTION_MAP), n_craft, n_items)
+    is_continuous: bool = False
+    distribution: str = "auto"
+    dense_units: int = 512
+    mlp_layers: int = 2
+    unimix: float = 0.01
+    init_std: float = 2.0
+    min_std: float = 0.1
+    max_std: float = 1.0
+    action_clip: float = 1.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False, mask=None):
+        if self.is_continuous:
+            raise ValueError("MinedojoActor only supports the functional MultiDiscrete action space")
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation="silu",
+            layer_norm=True,
+            norm_eps=1e-3,
+            dtype=self.dtype,
+        )(state)
+        heads = [nn.Dense(d, dtype=self.dtype, name=f"head_{i}")(x).astype(jnp.float32) for i, d in enumerate(self.actions_dim)]
+        keys = jax.random.split(key, len(heads)) if key is not None else [None] * len(heads)
+        neg_inf = jnp.finfo(jnp.float32).min
+
+        actions, dists = [], []
+        functional_action = None
+        for i, logits in enumerate(heads):
+            logits = unimix_logits(logits, self.unimix)
+            if mask is not None:
+                if i == 0:
+                    logits = jnp.where(mask["mask_action_type"], logits, neg_inf)
+                elif i == 1:
+                    # the craft argument constrains only when action-type 15 (craft)
+                    is_craft = (functional_action == 15)[..., None]
+                    allowed = jnp.where(is_craft, mask["mask_craft_smelt"], True)
+                    logits = jnp.where(allowed, logits, neg_inf)
+                elif i == 2:
+                    is_equip_place = jnp.logical_or(functional_action == 16, functional_action == 17)[..., None]
+                    is_destroy = (functional_action == 18)[..., None]
+                    allowed = jnp.where(is_equip_place, mask["mask_equip_place"], True)
+                    allowed = jnp.where(is_destroy, mask["mask_destroy"], allowed)
+                    logits = jnp.where(allowed, logits, neg_inf)
+            d = OneHotCategoricalStraightThrough(logits)
+            dists.append(d)
+            actions.append(d.mode if (greedy or keys[i] is None) else d.rsample(keys[i]))
+            if functional_action is None:
+                functional_action = actions[0].argmax(-1)
         return tuple(actions), tuple(dists)
 
 
@@ -634,12 +741,15 @@ def build_agent(
         reward_bins=wm_cfg.reward_model.bins,
         image_size=cfg.env.screen_size,
         learnable_initial_recurrent_state=wm_cfg.learnable_initial_recurrent_state,
+        decoupled_rssm=wm_cfg.get("decoupled_rssm", False),
         dtype=ctx.compute_dtype,
     )
     latent_size = (
         wm_cfg.stochastic_size * wm_cfg.discrete_size + wm_cfg.recurrent_model.recurrent_state_size
     )
-    actor = DreamerActor(
+    is_minedojo = "minedojo" in str(cfg.env.get("wrapper", {}).get("_target_", "")).lower()
+    actor_cls = MinedojoActor if is_minedojo else DreamerActor
+    actor = actor_cls(
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,
         distribution=cfg.distribution.get("type", "auto"),
@@ -688,11 +798,14 @@ def build_agent(
 
 def make_player_step(world_model: WorldModel, actor: DreamerActor, actions_dim: Sequence[int], discrete_size: int):
     """Build the pure player-step function: (params, state, obs, is_first, key) →
-    (env_actions, stored_actions, new_state)."""
+    (env_actions, stored_actions, new_state).  ``obs`` entries whose key starts with
+    ``mask`` are forwarded to the actor (MinedojoActor's hierarchical action masks,
+    reference ``PlayerDV3.get_actions`` mask plumbing)."""
 
     def player_step(params, state: PlayerState, obs, is_first, key, greedy: bool = False):
         k_repr, k_act = jax.random.split(key)
         wm, ap = params["world_model"], params["actor"]
+        mask = {k: v for k, v in obs.items() if k.startswith("mask")} or None
         embed = world_model.apply(wm, obs, method=WorldModel.encode)
         h0, z0 = world_model.apply(wm, state.recurrent_state.shape[:-1], method=WorldModel.initial_states)
         recurrent = (1 - is_first) * state.recurrent_state + is_first * h0
@@ -707,7 +820,7 @@ def make_player_step(world_model: WorldModel, actor: DreamerActor, actions_dim: 
         _, stoch_sample = world_model.apply(wm, recurrent, embed, k_repr, method=WorldModel.representation)
         stoch = stoch_sample.reshape(*stoch_sample.shape[:-2], -1)
         latent = jnp.concatenate([stoch, recurrent], -1)
-        actions, _ = actor.apply(ap, latent, k_act, greedy)
+        actions, _ = actor.apply(ap, latent, k_act, greedy, mask)
         stored = jnp.concatenate(actions, -1)
         return actions, stored, PlayerState(recurrent, stoch, stored)
 

@@ -101,22 +101,48 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys, obs_sha
         batch_actions = jnp.concatenate([jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], 0)
 
         # ------------------------------------------------ world model update
+        decoupled = wm_cfg.get("decoupled_rssm", False)
+
         def wm_loss_fn(wm_params):
             embed = world_model.apply(wm_params, batch_obs, method=WorldModel.encode)  # [T,B,E]
 
-            def step(carry, x):
-                post, rec = carry
-                action, emb, first, k = x
-                rec, post, _, post_logits, prior_logits = world_model.apply(
-                    wm_params, post, rec, action, emb, first, k, method=WorldModel.dynamic
+            if decoupled:
+                # DecoupledRSSM (reference agent.py:501-593): q(z|o) has no recurrent
+                # dependency, so the WHOLE posterior batch is one vectorized call and
+                # only the prior chain runs in the scan.
+                k_repr, k_scan = jax.random.split(k_wm)
+                post_logits, post_samples = world_model.apply(
+                    wm_params, embed, k_repr, method=WorldModel.representation_from_embed
                 )
-                return (post, rec), (rec, post, post_logits, prior_logits)
+                posts = post_samples.reshape(T, B, -1)
+                prev_posts = jnp.concatenate([jnp.zeros_like(posts[:1]), posts[:-1]], 0)
 
-            keys = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size)))
-            _, (recs, posts, post_logits, prior_logits) = jax.lax.scan(
-                step, init, (batch_actions, embed, is_first, keys)
-            )
+                def step(rec, x):
+                    prev_post, action, first, k = x
+                    rec, _, prior_logits = world_model.apply(
+                        wm_params, prev_post, rec, action, first, k, method=WorldModel.dynamic
+                    )
+                    return rec, (rec, prior_logits)
+
+                keys = jax.random.split(k_scan, T)
+                _, (recs, prior_logits) = jax.lax.scan(
+                    step, jnp.zeros((B, rec_size)), (prev_posts, batch_actions, is_first, keys)
+                )
+            else:
+
+                def step(carry, x):
+                    post, rec = carry
+                    action, emb, first, k = x
+                    rec, post, _, post_logits, prior_logits = world_model.apply(
+                        wm_params, post, rec, action, emb, first, k, method=WorldModel.dynamic
+                    )
+                    return (post, rec), (rec, post, post_logits, prior_logits)
+
+                keys = jax.random.split(k_wm, T)
+                init = (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size)))
+                _, (recs, posts, post_logits, prior_logits) = jax.lax.scan(
+                    step, init, (batch_actions, embed, is_first, keys)
+                )
             latents = jnp.concatenate([posts, recs], -1)  # [T,B,L]
             recon = world_model.apply(wm_params, latents, method=WorldModel.decode)
 

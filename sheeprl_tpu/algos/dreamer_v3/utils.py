@@ -56,13 +56,17 @@ def prepare_obs(
     obs: Dict[str, np.ndarray], cnn_keys: Sequence[str], mlp_keys: Sequence[str], num_envs: int = 1
 ) -> Dict[str, jax.Array]:
     """numpy env obs → [num_envs, ...] device arrays; images stay uint8 channel-first
-    (the encoder normalises), vectors flattened float."""
+    (the encoder normalises), vectors flattened float.  ``mask*`` entries (MineDojo
+    action masks) ride along as bools for the masked actor."""
     out: Dict[str, jax.Array] = {}
     for k in cnn_keys:
         v = np.asarray(obs[k])
         out[k] = jnp.asarray(v.reshape(num_envs, -1, *v.shape[-2:]))
     for k in mlp_keys:
         out[k] = jnp.asarray(np.asarray(obs[k], dtype=np.float32).reshape(num_envs, -1))
+    for k in obs:
+        if k.startswith("mask"):
+            out[k] = jnp.asarray(np.asarray(obs[k], dtype=bool).reshape(num_envs, -1))
     return out
 
 

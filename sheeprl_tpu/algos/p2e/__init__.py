@@ -106,6 +106,12 @@ def load_exploration_config(cfg) -> Any:
         "frame_stack_dilation",
         "max_episode_steps",
         "reward_as_observation",
+        # Minecraft adapters (reference cli.py:139-145)
+        "max_pitch",
+        "min_pitch",
+        "sticky_jump",
+        "sticky_attack",
+        "break_speed_multiplier",
     ):
         if key in exploration_cfg.env:
             cfg.env[key] = exploration_cfg.env[key]

@@ -1,12 +1,23 @@
 """MineDojo adapter (reference: ``/root/reference/sheeprl/envs/minedojo.py``).
 
-MultiDiscrete(3) functional action space {movement/camera, use/attack, craft-arg} with
-per-component **action masks** exposed in the observation (reference ``:168-183``),
-pitch/yaw limits and sticky attack/jump."""
+Functional ``MultiDiscrete(3)`` action space — (action-type, craft-arg, item-arg) —
+mapped onto MineDojo's native 8-dim action, with:
+
+* **action masks** in the observation (``mask_action_type`` / ``mask_equip_place`` /
+  ``mask_destroy`` / ``mask_craft_smelt``, reference ``:168-182``) consumed by the
+  hierarchical ``MinedojoActor``;
+* **sticky attack/jump**: a selected attack (or jump) is repeated for the next
+  ``sticky_attack`` (``sticky_jump``) steps unless a conflicting action is chosen
+  (reference ``:184-214``);
+* **pitch limits**: camera pitch commands that would leave ``pitch_limits`` are
+  replaced with the no-op camera index (reference ``:243-248``);
+* item-indexed inventory/equipment vectors over the full MineDojo item table.
+"""
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+import copy
+from typing import Any, Dict, Optional, SupportsFloat, Tuple
 
 import gymnasium as gym
 import numpy as np
@@ -17,6 +28,38 @@ if not _IS_MINEDOJO_AVAILABLE:
     raise ModuleNotFoundError("minedojo is not installed")
 
 import minedojo  # noqa: E402
+import minedojo.tasks  # noqa: E402
+from minedojo.sim import ALL_CRAFT_SMELT_ITEMS, ALL_ITEMS  # noqa: E402
+
+N_ALL_ITEMS = len(ALL_ITEMS)
+ITEM_ID_TO_NAME = dict(enumerate(ALL_ITEMS))
+ITEM_NAME_TO_ID = dict(zip(ALL_ITEMS, range(N_ALL_ITEMS)))
+_ALL_TASKS_SPECS = copy.deepcopy(minedojo.tasks.ALL_TASKS_SPECS)
+
+# Functional action-type table (reference ``:20-40``): index → native 8-dim action.
+# Native layout: [fwd/back, left/right, jump/sneak/sprint, pitch(25; 12=no-op),
+# yaw(25; 12=no-op), fn(8), craft-arg, item-arg].
+ACTION_MAP = {
+    0: np.array([0, 0, 0, 12, 12, 0, 0, 0]),  # no-op
+    1: np.array([1, 0, 0, 12, 12, 0, 0, 0]),  # forward
+    2: np.array([2, 0, 0, 12, 12, 0, 0, 0]),  # back
+    3: np.array([0, 1, 0, 12, 12, 0, 0, 0]),  # left
+    4: np.array([0, 2, 0, 12, 12, 0, 0, 0]),  # right
+    5: np.array([1, 0, 1, 12, 12, 0, 0, 0]),  # jump + forward
+    6: np.array([1, 0, 2, 12, 12, 0, 0, 0]),  # sneak + forward
+    7: np.array([1, 0, 3, 12, 12, 0, 0, 0]),  # sprint + forward
+    8: np.array([0, 0, 0, 11, 12, 0, 0, 0]),  # pitch down (-15°)
+    9: np.array([0, 0, 0, 13, 12, 0, 0, 0]),  # pitch up (+15°)
+    10: np.array([0, 0, 0, 12, 11, 0, 0, 0]),  # yaw left (-15°)
+    11: np.array([0, 0, 0, 12, 13, 0, 0, 0]),  # yaw right (+15°)
+    12: np.array([0, 0, 0, 12, 12, 1, 0, 0]),  # use
+    13: np.array([0, 0, 0, 12, 12, 2, 0, 0]),  # drop
+    14: np.array([0, 0, 0, 12, 12, 3, 0, 0]),  # attack
+    15: np.array([0, 0, 0, 12, 12, 4, 0, 0]),  # craft
+    16: np.array([0, 0, 0, 12, 12, 5, 0, 0]),  # equip
+    17: np.array([0, 0, 0, 12, 12, 6, 0, 0]),  # place
+    18: np.array([0, 0, 0, 12, 12, 7, 0, 0]),  # destroy
+}
 
 
 class MineDojoWrapper(gym.Env):
@@ -29,94 +72,195 @@ class MineDojoWrapper(gym.Env):
         width: int = 64,
         pitch_limits: Tuple[int, int] = (-60, 60),
         seed: Optional[int] = None,
-        sticky_attack: int = 30,
-        sticky_jump: int = 10,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
         **kwargs: Any,
     ):
+        self._break_speed_multiplier = kwargs.pop("break_speed_multiplier", 100)
+        self._start_pos = copy.deepcopy(kwargs.get("start_position", None))
+        self._pos = copy.deepcopy(self._start_pos)
+        if self._pos is not None and not (pitch_limits[0] <= self._pos["pitch"] <= pitch_limits[1]):
+            raise ValueError(
+                f"The initial position must respect the pitch limits {pitch_limits}, given {self._pos['pitch']}"
+            )
         self._env = minedojo.make(
-            task_id=id, image_size=(height, width), world_seed=seed, fast_reset=True, **kwargs
+            task_id=id,
+            image_size=(height, width),
+            world_seed=seed,
+            fast_reset=True,
+            break_speed_multiplier=self._break_speed_multiplier,
+            **kwargs,
         )
         self._pitch_limits = pitch_limits
-        self._sticky_attack = sticky_attack
-        self._sticky_jump = sticky_jump
+        # High break-speed already one-shots blocks; sticky attack would waste steps
+        # (reference ``:74``).
+        self._sticky_attack = 0 if self._break_speed_multiplier > 1 else (sticky_attack or 0)
+        self._sticky_jump = sticky_jump or 0
         self._sticky_attack_counter = 0
         self._sticky_jump_counter = 0
-        self._pos = {"pitch": 0.0, "yaw": 0.0}
-        # Functional action space: 12 movement/camera combos x 3 fn x 8 craft args
-        self.action_space = gym.spaces.MultiDiscrete([12, 3, 8])
+        self._inventory: Dict[str, list] = {}
+        self._inventory_names = np.array([])
+        self._inventory_max = np.zeros(N_ALL_ITEMS)
+
+        self.action_space = gym.spaces.MultiDiscrete(
+            np.array([len(ACTION_MAP), len(ALL_CRAFT_SMELT_ITEMS), N_ALL_ITEMS])
+        )
         self.observation_space = gym.spaces.Dict(
             {
                 "rgb": gym.spaces.Box(0, 255, (3, height, width), np.uint8),
-                "inventory": gym.spaces.Box(-np.inf, np.inf, (36,), np.float32),
-                "equipment": gym.spaces.Box(-np.inf, np.inf, (1,), np.float32),
-                "life_stats": gym.spaces.Box(-np.inf, np.inf, (3,), np.float32),
-                "mask_action_type": gym.spaces.Box(0, 1, (12,), bool),
-                "mask_craft_smelt": gym.spaces.Box(0, 1, (8,), bool),
+                "inventory": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
+                "inventory_max": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
+                "inventory_delta": gym.spaces.Box(-np.inf, np.inf, (N_ALL_ITEMS,), np.float32),
+                "equipment": gym.spaces.Box(0.0, 1.0, (N_ALL_ITEMS,), np.int32),
+                "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+                "mask_action_type": gym.spaces.Box(0, 1, (len(ACTION_MAP),), bool),
+                "mask_equip_place": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                "mask_destroy": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                "mask_craft_smelt": gym.spaces.Box(0, 1, (len(ALL_CRAFT_SMELT_ITEMS),), bool),
             }
         )
+        self.render_mode = "rgb_array"
+        minedojo.tasks.ALL_TASKS_SPECS = copy.deepcopy(_ALL_TASKS_SPECS)
 
+    # -- conversions --------------------------------------------------------
     def _convert_action(self, action: np.ndarray) -> np.ndarray:
-        """Map the functional MultiDiscrete(3) to MineDojo's native 8-dim action.
+        """Functional triple → native action, with sticky attack/jump
+        (reference ``:184-224``)."""
+        converted = ACTION_MAP[int(action[0])].copy()
+        if self._sticky_attack:
+            if converted[5] == 3:  # attack selected: arm the counter
+                self._sticky_attack_counter = self._sticky_attack - 1
+            if self._sticky_attack_counter > 0 and converted[5] == 0:
+                converted[5] = 3  # repeat the attack while no other fn action chosen
+                self._sticky_attack_counter -= 1
+            elif converted[5] != 3:
+                self._sticky_attack_counter = 0
+        if self._sticky_jump:
+            if converted[2] == 1:  # jump selected: arm the counter
+                self._sticky_jump_counter = self._sticky_jump - 1
+            if self._sticky_jump_counter > 0 and converted[0] == 0:
+                converted[2] = 1
+                # the sticky jump also moves forward unless another movement is chosen
+                if converted[0] == converted[1] == 0:
+                    converted[0] = 1
+                self._sticky_jump_counter -= 1
+            elif converted[2] != 1:
+                self._sticky_jump_counter = 0
+        # craft (fn=4) consumes the craft argument; equip/place/destroy (5/6/7) consume
+        # the inventory slot of the selected item.
+        converted[6] = int(action[1]) if converted[5] == 4 else 0
+        if converted[5] in {5, 6, 7}:
+            slots = self._inventory.get(ITEM_ID_TO_NAME[int(action[2])])
+            if slots is None:
+                # item not in inventory (e.g. unmasked random prefill): no-op instead
+                # of crashing — the masked actor never requests these
+                converted[5] = 0
+                converted[7] = 0
+            else:
+                converted[7] = slots[0]
+        else:
+            converted[7] = 0
+        return converted
 
-        Native layout: [fwd/back(3), left/right(3), jump/sneak/sprint(4),
-        camera-pitch(25, 12=no-op), camera-yaw(25, 12=no-op), fn(8), craft(244→8), ...]."""
-        native = np.zeros(8, dtype=np.int64)
-        native[3] = native[4] = 12  # camera no-op is the centre index
-        a0 = int(action[0])
-        if a0 == 1:
-            native[0] = 1  # forward
-        elif a0 == 2:
-            native[0] = 2  # back
-        elif a0 == 3:
-            native[1] = 1  # left
-        elif a0 == 4:
-            native[1] = 2  # right
-        elif a0 == 5:
-            native[2] = 1  # jump
-        elif a0 == 6:
-            native[3] = 11  # pitch down 15°
-        elif a0 == 7:
-            native[3] = 13  # pitch up 15°
-        elif a0 == 8:
-            native[4] = 11  # yaw left 15°
-        elif a0 == 9:
-            native[4] = 13  # yaw right 15°
-        elif a0 == 10:
-            native[2] = 2  # sneak
-        elif a0 == 11:
-            native[2] = 3  # sprint
-        fn = int(action[1])
-        if fn == 1:
-            native[5] = 1  # use
-        elif fn == 2:
-            native[5] = 3  # attack
-        native[6] = int(action[2])  # craft argument
-        return native
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
+        counts = np.zeros(N_ALL_ITEMS)
+        self._inventory = {}
+        self._inventory_names = np.array(["_".join(i.split(" ")) for i in inventory["name"].copy().tolist()])
+        for i, (item, quantity) in enumerate(zip(inventory["name"], inventory["quantity"])):
+            item = "_".join(item.split(" "))
+            self._inventory.setdefault(item, []).append(i)
+            counts[ITEM_NAME_TO_ID[item]] += 1 if item == "air" else quantity
+        self._inventory_max = np.maximum(counts, self._inventory_max)
+        return counts
 
-    def _obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        masks = obs.get("masks", {})
+    def _convert_inventory_delta(self, delta: Dict[str, Any]) -> np.ndarray:
+        out = np.zeros(N_ALL_ITEMS)
+        for names_key, qty_key, sign in (
+            ("inc_name_by_craft", "inc_quantity_by_craft", 1),
+            ("dec_name_by_craft", "dec_quantity_by_craft", -1),
+            ("inc_name_by_other", "inc_quantity_by_other", 1),
+            ("dec_name_by_other", "dec_quantity_by_other", -1),
+        ):
+            for item, quantity in zip(delta[names_key], delta[qty_key]):
+                out[ITEM_NAME_TO_ID["_".join(item.split(" "))]] += sign * quantity
+        return out
+
+    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        equip = np.zeros(N_ALL_ITEMS, dtype=np.int32)
+        equip[ITEM_NAME_TO_ID["_".join(equipment["name"][0].split(" "))]] = 1
+        return equip
+
+    def _convert_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        equip_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
+        destroy_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
+        for item, eqp, dst in zip(self._inventory_names, masks["equip"], masks["destroy"]):
+            idx = ITEM_NAME_TO_ID[item]
+            equip_mask[idx] = eqp
+            destroy_mask[idx] = dst
+        masks["action_type"][5:7] *= np.any(equip_mask).item()
+        masks["action_type"][7] *= np.any(destroy_mask).item()
         return {
-            "rgb": np.asarray(obs["rgb"], dtype=np.uint8),
-            "inventory": np.asarray(obs.get("inventory", {}).get("quantity", np.zeros(36)), dtype=np.float32),
-            "equipment": np.zeros(1, dtype=np.float32),
-            "life_stats": np.asarray(
-                [
-                    float(obs.get("life_stats", {}).get("life", 20)),
-                    float(obs.get("life_stats", {}).get("food", 20)),
-                    float(obs.get("life_stats", {}).get("oxygen", 300)),
-                ],
-                dtype=np.float32,
-            ),
-            "mask_action_type": np.asarray(masks.get("action_type", np.ones(12)), dtype=bool)[:12],
-            "mask_craft_smelt": np.asarray(masks.get("craft_smelt", np.ones(8)), dtype=bool)[:8],
+            # movement/camera (first 12) are always allowed; fn actions follow the env mask
+            "mask_action_type": np.concatenate((np.array([True] * 12), masks["action_type"][1:])),
+            "mask_equip_place": equip_mask,
+            "mask_destroy": destroy_mask,
+            "mask_craft_smelt": masks["craft_smelt"],
         }
 
-    def step(self, action):
-        obs, reward, done, info = self._env.step(self._convert_action(np.asarray(action)))
-        return self._obs(obs), reward, done, False, info
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            "rgb": np.asarray(obs["rgb"], dtype=np.uint8).copy(),
+            "inventory": self._convert_inventory(obs["inventory"]).astype(np.float32),
+            "inventory_max": self._inventory_max.astype(np.float32),
+            "inventory_delta": self._convert_inventory_delta(obs["delta_inv"]).astype(np.float32),
+            "equipment": self._convert_equipment(obs["equipment"]),
+            "life_stats": np.concatenate(
+                (obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["oxygen"])
+            ).astype(np.float32),
+            **self._convert_masks(obs["masks"]),
+        }
+
+    # -- gym API -------------------------------------------------------------
+    def step(self, action: np.ndarray) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        action = self._convert_action(np.asarray(action))
+        # Clamp the camera pitch to the limits (reference ``:246-248``).
+        next_pitch = self._pos["pitch"] + (action[3] - 12) * 15 if self._pos else 0.0
+        if self._pos and not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            action[3] = 12
+
+        obs, reward, done, info = self._env.step(action)
+        is_timelimit = info.get("TimeLimit.truncated", False)
+        terminated = done and not is_timelimit
+        truncated = done and is_timelimit
+        self._pos = {
+            "x": float(obs["location_stats"]["pos"][0]),
+            "y": float(obs["location_stats"]["pos"][1]),
+            "z": float(obs["location_stats"]["pos"][2]),
+            "pitch": float(obs["location_stats"]["pitch"].item()),
+            "yaw": float(obs["location_stats"]["yaw"].item()),
+        }
+        info = {**info, "location_stats": copy.deepcopy(self._pos)}
+        return self._convert_obs(obs), reward, terminated, truncated, info
 
     def reset(self, seed=None, options=None):
-        return self._obs(self._env.reset()), {}
+        obs = self._env.reset()
+        self._pos = {
+            "x": float(obs["location_stats"]["pos"][0]),
+            "y": float(obs["location_stats"]["pos"][1]),
+            "z": float(obs["location_stats"]["pos"][2]),
+            "pitch": float(obs["location_stats"]["pitch"].item()),
+            "yaw": float(obs["location_stats"]["yaw"].item()),
+        }
+        self._sticky_jump_counter = 0
+        self._sticky_attack_counter = 0
+        self._inventory_max = np.zeros(N_ALL_ITEMS)
+        return self._convert_obs(obs), {"location_stats": copy.deepcopy(self._pos)}
+
+    def render(self):
+        prev = getattr(self._env.unwrapped, "_prev_obs", None)
+        if prev is not None and "rgb" in prev:
+            return np.moveaxis(np.asarray(prev["rgb"]), 0, -1)
+        return None
 
     def close(self):
         self._env.close()

@@ -1,9 +1,17 @@
-"""MineRL adapter (reference: ``/root/reference/sheeprl/envs/minerl.py:48`` + custom
-Navigate/Obtain task definitions under ``envs/minerl_envs/``)."""
+"""MineRL adapter (reference: ``/root/reference/sheeprl/envs/minerl.py``).
+
+Wraps the custom Navigate/Obtain env specs (``sheeprl_tpu/envs/minerl_envs.py``) behind
+a flat ``Discrete`` action space built DYNAMICALLY from the task's action handlers
+(reference ``:100-141``): one index per keyboard/camera primitive plus one per non-none
+enum value of every craft/place/equip/smelt action.  Sticky attack/jump, pitch/yaw
+limits and a multihot (full Minecraft item table) inventory/equipment encoding match
+the MineDojo adapter's conventions.
+"""
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+import copy
+from typing import Any, Dict, Optional, SupportsFloat, Tuple
 
 import gymnasium as gym
 import numpy as np
@@ -13,7 +21,45 @@ from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
 if not _IS_MINERL_AVAILABLE:
     raise ModuleNotFoundError("minerl is not installed")
 
-import minerl  # noqa: E402, F401
+import minerl  # noqa: E402
+from minerl.herobraine.hero import mc  # noqa: E402
+
+from sheeprl_tpu.envs.minerl_envs import (  # noqa: E402
+    CustomNavigate,
+    CustomObtainDiamond,
+    CustomObtainIronPickaxe,
+)
+
+CUSTOM_ENVS = {
+    "custom_navigate": CustomNavigate,
+    "custom_obtain_diamond": CustomObtainDiamond,
+    "custom_obtain_iron_pickaxe": CustomObtainIronPickaxe,
+}
+
+N_ALL_ITEMS = len(mc.ALL_ITEMS)
+ITEM_NAME_TO_ID = dict(zip(mc.ALL_ITEMS, range(N_ALL_ITEMS)))
+NOOP_ACTION: Dict[str, Any] = {
+    "camera": (0, 0),
+    "forward": 0,
+    "back": 0,
+    "left": 0,
+    "right": 0,
+    "attack": 0,
+    "sprint": 0,
+    "jump": 0,
+    "sneak": 0,
+    "craft": "none",
+    "nearbyCraft": "none",
+    "nearbySmelt": "none",
+    "place": "none",
+    "equip": "none",
+}
+CAMERA_DELTAS = (
+    np.array([-15, 0]),  # pitch down
+    np.array([15, 0]),  # pitch up
+    np.array([0, -15]),  # yaw left
+    np.array([0, 15]),  # yaw right
+)
 
 
 class MineRLWrapper(gym.Env):
@@ -24,63 +70,152 @@ class MineRLWrapper(gym.Env):
         id: str,
         height: int = 64,
         width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
         seed: Optional[int] = None,
-        break_speed_multiplier: int = 100,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        break_speed_multiplier: Optional[int] = 100,
+        multihot_inventory: bool = True,
         **kwargs: Any,
     ):
-        import gym as old_gym
-
-        self._env = old_gym.make(id)
-        if seed is not None:
-            self._env.seed(seed)
         self._height, self._width = height, width
-        # Discretised functional action space mirroring the reference's mapping.
-        self.action_space = gym.spaces.MultiDiscrete([12, 3, 8])
-        self.observation_space = gym.spaces.Dict(
-            {
-                "rgb": gym.spaces.Box(0, 255, (3, height, width), np.uint8),
-                "compass": gym.spaces.Box(-180, 180, (1,), np.float32),
-                "inventory": gym.spaces.Box(-np.inf, np.inf, (1,), np.float32),
-            }
-        )
+        self._pitch_limits = pitch_limits
+        self._sticky_attack = 0 if (break_speed_multiplier or 1) > 1 else (sticky_attack or 0)
+        self._sticky_jump = sticky_jump or 0
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._multihot = multihot_inventory
+        if "navigate" not in id.lower():
+            kwargs.pop("extreme", None)
+        self._env = CUSTOM_ENVS[id.lower()](break_speed=break_speed_multiplier, **kwargs).make()
 
-    def _obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        pov = np.asarray(obs.get("pov", np.zeros((self._height, self._width, 3))), dtype=np.uint8)
-        compass = obs.get("compass", {}).get("angle", 0.0) if isinstance(obs.get("compass"), dict) else 0.0
-        inventory = obs.get("inventory", {})
-        dirt = float(inventory.get("dirt", 0)) if isinstance(inventory, dict) else 0.0
-        return {
-            "rgb": np.transpose(pov, (2, 0, 1)),
-            "compass": np.asarray([compass], dtype=np.float32),
-            "inventory": np.asarray([dirt], dtype=np.float32),
+        # Discrete action table: index 0 = no-op; binary keys contribute one entry,
+        # the camera four (±15° pitch/yaw), enum actions one per non-"none" value.
+        self._actions: Dict[int, Dict[str, Any]] = {0: {}}
+        idx = 1
+        for name in self._env.action_space:
+            space = self._env.action_space[name]
+            if isinstance(space, minerl.herobraine.hero.spaces.Enum):
+                values = sorted(set(space.values.tolist()) - {"none"})
+                entries = [{name: v} for v in values]
+            elif name == "camera":
+                entries = [{name: delta} for delta in CAMERA_DELTAS]
+            else:
+                entries = [{name: 1}]
+            for entry in entries:
+                if name in {"jump", "sneak", "sprint"}:
+                    entry["forward"] = 1  # match the MineDojo movement combos
+                self._actions[idx] = entry
+                idx += 1
+        self.action_space = gym.spaces.Discrete(len(self._actions))
+
+        if multihot_inventory:
+            self._inventory_item_to_id = ITEM_NAME_TO_ID
+            self._inventory_size = N_ALL_ITEMS
+        else:
+            names = list(self._env.observation_space["inventory"])
+            self._inventory_item_to_id = dict(zip(names, range(len(names))))
+            self._inventory_size = len(names)
+
+        obs_space: Dict[str, gym.spaces.Space] = {
+            "rgb": gym.spaces.Box(0, 255, (3, height, width), np.uint8),
+            "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+            "inventory": gym.spaces.Box(0.0, np.inf, (self._inventory_size,), np.float32),
+            "max_inventory": gym.spaces.Box(0.0, np.inf, (self._inventory_size,), np.float32),
         }
+        if "compass" in self._env.observation_space.spaces:
+            obs_space["compass"] = gym.spaces.Box(-180, 180, (1,), np.float32)
+        self._has_equipment = "equipped_items" in self._env.observation_space.spaces
+        if self._has_equipment:
+            if multihot_inventory:
+                self._equip_item_to_id = ITEM_NAME_TO_ID
+                self._equip_size = N_ALL_ITEMS
+            else:
+                values = self._env.observation_space["equipped_items"]["mainhand"]["type"].values.tolist()
+                self._equip_item_to_id = dict(zip(values, range(len(values))))
+                self._equip_size = len(values)
+            obs_space["equipment"] = gym.spaces.Box(0.0, 1.0, (self._equip_size,), np.int32)
+        self.observation_space = gym.spaces.Dict(obs_space)
 
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        self._max_inventory = np.zeros(self._inventory_size)
+        self.render_mode = "rgb_array"
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    # -- conversions --------------------------------------------------------
     def _convert_action(self, action: np.ndarray) -> Dict[str, Any]:
-        act = self._env.action_space.no_op()
-        a0 = int(action[0])
-        if a0 == 1:
-            act["forward"] = 1
-        elif a0 == 2:
-            act["back"] = 1
-        elif a0 == 3:
-            act["left"] = 1
-        elif a0 == 4:
-            act["right"] = 1
-        elif a0 == 5:
-            act["jump"] = 1
-            act["forward"] = 1
-        elif a0 >= 6:
-            act["camera"] = [[-15, 0], [15, 0], [0, -15], [0, 15], [0, 0], [0, 0]][a0 - 6]
-        if int(action[1]) == 1:
-            act["attack"] = 1
-        return act
+        out = copy.deepcopy(NOOP_ACTION)
+        out.update(self._actions[int(np.asarray(action).item())])
+        # Sticky attack/jump (reference ``:237-251``): a selected attack (jump) keeps
+        # firing for the next N steps; attack suppresses jumping, jumping moves forward.
+        if self._sticky_attack:
+            if out["attack"]:
+                self._sticky_attack_counter = self._sticky_attack
+            if self._sticky_attack_counter > 0:
+                out["attack"] = 1
+                out["jump"] = 0
+                self._sticky_attack_counter -= 1
+        if self._sticky_jump:
+            if out["jump"]:
+                self._sticky_jump_counter = self._sticky_jump
+            if self._sticky_jump_counter > 0:
+                out["jump"] = 1
+                out["forward"] = 1
+                self._sticky_jump_counter -= 1
+        return out
 
-    def step(self, action):
-        obs, reward, done, info = self._env.step(self._convert_action(np.asarray(action)))
-        return self._obs(obs), reward, done, False, info
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        counts = np.zeros(self._inventory_size)
+        for item, quantity in inventory.items():
+            counts[self._inventory_item_to_id[item]] += 1 if item == "air" else quantity
+        self._max_inventory = np.maximum(counts, self._max_inventory)
+        return {"inventory": counts.astype(np.float32), "max_inventory": self._max_inventory.astype(np.float32)}
+
+    def _convert_equipment(self, equipped: Dict[str, Any]) -> np.ndarray:
+        equip = np.zeros(self._equip_size, dtype=np.int32)
+        equip[self._equip_item_to_id.get(equipped["mainhand"]["type"], self._equip_item_to_id["air"])] = 1
+        return equip
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        out = {
+            "rgb": np.asarray(obs["pov"], dtype=np.uint8).transpose(2, 0, 1).copy(),
+            "life_stats": np.asarray(
+                [obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["air"]],
+                dtype=np.float32,
+            ),
+            **self._convert_inventory(obs["inventory"]),
+        }
+        if self._has_equipment:
+            out["equipment"] = self._convert_equipment(obs["equipped_items"])
+        if "compass" in self.observation_space.spaces:
+            out["compass"] = np.asarray(obs["compass"]["angle"], dtype=np.float32).reshape(-1)
+        return out
+
+    # -- gym API -------------------------------------------------------------
+    def step(self, action: np.ndarray) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        converted = self._convert_action(action)
+        # Clamp the camera pitch to the limits (reference ``:295-299``).
+        next_pitch = self._pos["pitch"] + converted["camera"][0]
+        next_yaw = ((self._pos["yaw"] + converted["camera"][1]) + 180) % 360 - 180
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            converted["camera"] = np.array([0, converted["camera"][1]])
+            next_pitch = self._pos["pitch"]
+
+        obs, reward, done, info = self._env.step(converted)
+        self._pos = {"pitch": next_pitch, "yaw": next_yaw}
+        return self._convert_obs(obs), reward, done, False, info
 
     def reset(self, seed=None, options=None):
-        return self._obs(self._env.reset()), {}
+        obs = self._env.reset()
+        self._max_inventory = np.zeros(self._inventory_size)
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        return self._convert_obs(obs), {}
+
+    def render(self):
+        return self._env.render(self.render_mode)
 
     def close(self):
         self._env.close()

@@ -1,0 +1,297 @@
+"""Custom MineRL task specs (reference: ``/root/reference/sheeprl/envs/minerl_envs/``
+— Navigate ``navigate.py``, Obtain ``obtain.py``, base spec ``backend.py``; themselves
+adapted from the public minerllabs/minerl env definitions).
+
+Table-driven re-derivation of the three custom tasks the reference ships for the
+Minecraft results in BASELINE.md:
+
+* ``CustomNavigate``: reach a diamond block ~64 m away using a compass; +100 sparse
+  reward (plus per-block shaping in the dense variant);
+* ``CustomObtainDiamond`` / ``CustomObtainIronPickaxe``: item-hierarchy tasks with the
+  standard exponential reward schedule.
+
+All specs share the DreamerV3-Minecraft conventions: 64×64 POV, a break-speed
+multiplier (danijar/diamond_env's trick), no in-env time limit (the gymnasium
+``TimeLimit`` wrapper distinguishes terminated/truncated instead).
+"""
+
+from __future__ import annotations
+
+from abc import ABC
+from typing import Any, Dict, List
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError("minerl is not installed")
+
+import minerl.herobraine.hero.handlers as handlers  # noqa: E402
+from minerl.herobraine.env_spec import EnvSpec  # noqa: E402
+from minerl.herobraine.hero import handler  # noqa: E402
+from minerl.herobraine.hero.mc import INVERSE_KEYMAP  # noqa: E402
+
+MOVEMENT_KEYS = ("forward", "back", "left", "right", "jump", "sneak", "sprint", "attack")
+NAVIGATE_STEPS = 6000
+
+# The item hierarchy up to a diamond, with the standard exponential rewards.
+DIAMOND_REWARD_SCHEDULE = [
+    {"type": "log", "amount": 1, "reward": 1},
+    {"type": "planks", "amount": 1, "reward": 2},
+    {"type": "stick", "amount": 1, "reward": 4},
+    {"type": "crafting_table", "amount": 1, "reward": 4},
+    {"type": "wooden_pickaxe", "amount": 1, "reward": 8},
+    {"type": "cobblestone", "amount": 1, "reward": 16},
+    {"type": "furnace", "amount": 1, "reward": 32},
+    {"type": "stone_pickaxe", "amount": 1, "reward": 32},
+    {"type": "iron_ore", "amount": 1, "reward": 64},
+    {"type": "iron_ingot", "amount": 1, "reward": 128},
+    {"type": "iron_pickaxe", "amount": 1, "reward": 256},
+    {"type": "diamond", "amount": 1, "reward": 1024},
+]
+
+OBTAIN_INVENTORY_ITEMS = [
+    "dirt", "coal", "torch", "log", "planks", "stick", "crafting_table",
+    "wooden_axe", "wooden_pickaxe", "stone", "cobblestone", "furnace",
+    "stone_axe", "stone_pickaxe", "iron_ore", "iron_ingot", "iron_axe", "iron_pickaxe",
+]
+TOOL_ITEMS = ["wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe", "iron_axe", "iron_pickaxe"]
+
+
+class BreakSpeedMultiplier(handler.Handler):
+    """Malmo mission flag that scales block-breaking speed
+    (danijar/diamond_env; reference ``backend.py:53-61``)."""
+
+    def __init__(self, multiplier: float = 1.0):
+        self.multiplier = multiplier
+
+    def to_string(self) -> str:
+        return f"break_speed({self.multiplier})"
+
+    def xml_template(self) -> str:
+        return "<BreakSpeedMultiplier>{{multiplier}}</BreakSpeedMultiplier>"
+
+
+class _TpuEmbodimentSpec(EnvSpec, ABC):
+    """Shared base: POV + location + life-stats observations, keyboard movement +
+    camera actions, break-speed start handler (reference ``backend.py:19-50``)."""
+
+    def __init__(self, name: str, *args: Any, resolution=(64, 64), break_speed: int = 100, **kwargs: Any):
+        self.resolution = resolution
+        self.break_speed = break_speed
+        super().__init__(name, *args, **kwargs)
+
+    def create_agent_start(self) -> List[handler.Handler]:
+        return [BreakSpeedMultiplier(self.break_speed)]
+
+    def create_observables(self) -> List[handler.Handler]:
+        return [
+            handlers.POVObservation(self.resolution),
+            handlers.ObservationFromCurrentLocation(),
+            handlers.ObservationFromLifeStats(),
+        ]
+
+    def create_actionables(self) -> List[handler.Handler]:
+        keyboard = [
+            handlers.KeybasedCommandAction(key, binding)
+            for key, binding in INVERSE_KEYMAP.items()
+            if key in MOVEMENT_KEYS
+        ]
+        return keyboard + [handlers.CameraAction()]
+
+    def create_monitors(self) -> List[handler.Handler]:
+        return []
+
+
+class CustomNavigate(_TpuEmbodimentSpec):
+    """Compass navigation to a diamond block (reference ``navigate.py:18-97``)."""
+
+    def __init__(self, dense: bool, extreme: bool, *args: Any, **kwargs: Any):
+        self.dense, self.extreme = dense, extreme
+        name = "CustomMineRLNavigate{}{}-v0".format("Extreme" if extreme else "", "Dense" if dense else "")
+        # terminated/truncated are disambiguated by the outer TimeLimit wrapper.
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(name, *args, max_episode_steps=None, **kwargs)
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == ("navigateextreme" if self.extreme else "navigate")
+
+    def create_observables(self) -> List[handler.Handler]:
+        return super().create_observables() + [
+            handlers.CompassObservation(angle=True, distance=False),
+            handlers.FlatInventoryObservation(["dirt"]),
+        ]
+
+    def create_actionables(self) -> List[handler.Handler]:
+        return super().create_actionables() + [
+            handlers.PlaceBlock(["none", "dirt"], _other="none", _default="none")
+        ]
+
+    def create_rewardables(self) -> List[handler.Handler]:
+        rewards: List[handler.Handler] = [
+            handlers.RewardForTouchingBlockType(
+                [{"type": "diamond_block", "behaviour": "onceOnly", "reward": 100.0}]
+            )
+        ]
+        if self.dense:
+            rewards.append(handlers.RewardForDistanceTraveledToCompassTarget(reward_per_block=1.0))
+        return rewards
+
+    def create_agent_start(self) -> List[handler.Handler]:
+        return super().create_agent_start() + [
+            handlers.SimpleInventoryAgentStart([{"type": "compass", "quantity": "1"}])
+        ]
+
+    def create_agent_handlers(self) -> List[handler.Handler]:
+        return [handlers.AgentQuitFromTouchingBlockType(["diamond_block"])]
+
+    def create_server_world_generators(self) -> List[handler.Handler]:
+        if self.extreme:
+            return [handlers.BiomeGenerator(biome=3, force_reset=True)]
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+    def create_server_quit_producers(self) -> List[handler.Handler]:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+    def create_server_decorators(self) -> List[handler.Handler]:
+        return [
+            handlers.NavigationDecorator(
+                max_randomized_radius=64,
+                min_randomized_radius=64,
+                block="diamond_block",
+                placement="surface",
+                max_radius=8,
+                min_radius=0,
+                max_randomized_distance=8,
+                min_randomized_distance=0,
+                randomize_compass_location=True,
+            )
+        ]
+
+    def create_server_initial_conditions(self) -> List[handler.Handler]:
+        return [
+            handlers.TimeInitialCondition(allow_passage_of_time=False, start_time=6000),
+            handlers.WeatherInitialCondition("clear"),
+            handlers.SpawningInitialCondition("false"),
+        ]
+
+    def get_docstring(self) -> str:
+        flavour = "dense (per-block shaping)" if self.dense else "sparse (+100 at the goal)"
+        biome = "an extreme-hills biome" if self.extreme else "a random survival map"
+        return f"Navigate to a diamond block ~64m away using the compass; {flavour} reward; spawns on {biome}."
+
+    def determine_success_from_rewards(self, rewards: list) -> bool:
+        threshold = 100.0 + (60.0 if self.dense else 0.0)
+        return sum(rewards) >= threshold
+
+
+class CustomObtain(_TpuEmbodimentSpec):
+    """Item-hierarchy task with GUI-free craft/smelt/equip actions
+    (reference ``obtain.py:23-169``)."""
+
+    def __init__(
+        self,
+        target_item: str,
+        dense: bool,
+        reward_schedule: List[Dict[str, Any]],
+        *args: Any,
+        max_episode_steps=None,
+        **kwargs: Any,
+    ):
+        self.target_item = target_item
+        self.dense = dense
+        self.reward_schedule = reward_schedule
+        camel = "".join(part.capitalize() for part in target_item.split("_"))
+        name = "CustomMineRLObtain{}{}-v0".format(camel, "Dense" if dense else "")
+        super().__init__(name, *args, max_episode_steps=max_episode_steps, **kwargs)
+
+    def create_observables(self) -> List[handler.Handler]:
+        return super().create_observables() + [
+            handlers.FlatInventoryObservation(OBTAIN_INVENTORY_ITEMS),
+            handlers.EquippedItemObservation(
+                items=["air", *TOOL_ITEMS, "other"], _default="air", _other="other"
+            ),
+        ]
+
+    def create_actionables(self) -> List[handler.Handler]:
+        none = "none"
+        return super().create_actionables() + [
+            handlers.PlaceBlock(
+                [none, "dirt", "stone", "cobblestone", "crafting_table", "furnace", "torch"],
+                _other=none,
+                _default=none,
+            ),
+            handlers.EquipAction([none, "air", *TOOL_ITEMS], _other=none, _default=none),
+            handlers.CraftAction([none, "torch", "stick", "planks", "crafting_table"], _other=none, _default=none),
+            handlers.CraftNearbyAction([none, *TOOL_ITEMS, "furnace"], _other=none, _default=none),
+            handlers.SmeltItemNearby([none, "iron_ingot", "coal"], _other=none, _default=none),
+        ]
+
+    def create_rewardables(self) -> List[handler.Handler]:
+        reward_cls = handlers.RewardForCollectingItems if self.dense else handlers.RewardForCollectingItemsOnce
+        return [reward_cls(self.reward_schedule or {self.target_item: 1})]
+
+    def create_agent_handlers(self) -> List[handler.Handler]:
+        return [handlers.AgentQuitFromPossessingItem([{"type": "diamond", "amount": 1}])]
+
+    def create_server_world_generators(self) -> List[handler.Handler]:
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+    def create_server_quit_producers(self) -> List[handler.Handler]:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+    def create_server_decorators(self) -> List[handler.Handler]:
+        return []
+
+    def create_server_initial_conditions(self) -> List[handler.Handler]:
+        return [
+            handlers.TimeInitialCondition(start_time=6000, allow_passage_of_time=True),
+            handlers.SpawningInitialCondition(allow_spawning=True),
+        ]
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == f"o_{self.target_item}"
+
+    def get_docstring(self) -> str:
+        cadence = "every time it obtains an item" if self.dense else "once per distinct item"
+        return f"Obtain a {self.target_item}; rewarded {cadence} along the item hierarchy."
+
+    def determine_success_from_rewards(self, rewards: list) -> bool:
+        # Success = the run hit (almost) every milestone reward at least once.
+        reward_values = [entry["reward"] for entry in self.reward_schedule]
+        max_missing = round(len(self.reward_schedule) * 0.1)
+        return len(set(rewards).intersection(reward_values)) >= len(reward_values) - max_missing
+
+
+class CustomObtainDiamond(CustomObtain):
+    def __init__(self, dense: bool, *args: Any, **kwargs: Any):
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(
+            target_item="diamond",
+            dense=dense,
+            reward_schedule=list(DIAMOND_REWARD_SCHEDULE),
+            max_episode_steps=None,
+            *args,
+            **kwargs,
+        )
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == "o_dia"
+
+
+class CustomObtainIronPickaxe(CustomObtain):
+    def __init__(self, dense: bool, *args: Any, **kwargs: Any):
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(
+            target_item="iron_pickaxe",
+            dense=dense,
+            reward_schedule=list(DIAMOND_REWARD_SCHEDULE[:-1]),  # up to the iron pickaxe
+            max_episode_steps=None,
+            *args,
+            **kwargs,
+        )
+
+    def create_agent_handlers(self) -> List[handler.Handler]:
+        return [handlers.AgentQuitFromCraftingItem([{"type": "iron_pickaxe", "amount": 1}])]
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == "o_iron"

@@ -365,3 +365,11 @@ def test_sac_decoupled_dummy_env(tmp_path):
     ckpts = _ckpts(tmp_path)
     assert ckpts
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+def test_dreamer_v3_decoupled_rssm(tmp_path):
+    run(
+        DV3_ARGS
+        + ["env=discrete_dummy", "algo.world_model.decoupled_rssm=True"]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )

@@ -1,0 +1,62 @@
+"""MinedojoActor hierarchical masking (reference dreamer_v3/agent.py:848-932)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import MinedojoActor
+
+
+def _build(actions_dim=(19, 6, 10)):
+    actor = MinedojoActor(actions_dim=actions_dim, is_continuous=False, dense_units=8, mlp_layers=1)
+    params = actor.init(jax.random.PRNGKey(0), jnp.zeros((4, 16)), jax.random.PRNGKey(1))
+    return actor, params
+
+
+def test_action_type_mask_is_respected():
+    actor, params = _build()
+    mask = {
+        # only actions 3 and 15 allowed
+        "mask_action_type": jnp.zeros((4, 19), bool).at[:, 3].set(True).at[:, 15].set(True),
+        "mask_craft_smelt": jnp.ones((4, 6), bool),
+        "mask_equip_place": jnp.ones((4, 10), bool),
+        "mask_destroy": jnp.ones((4, 10), bool),
+    }
+    for seed in range(5):
+        actions, _ = actor.apply(params, jnp.ones((4, 16)), jax.random.PRNGKey(seed), False, mask)
+        chosen = np.asarray(actions[0].argmax(-1))
+        assert np.isin(chosen, [3, 15]).all(), chosen
+
+
+def test_craft_mask_applies_only_when_crafting():
+    actor, params = _build()
+    base = {
+        "mask_craft_smelt": jnp.zeros((4, 6), bool).at[:, 2].set(True),
+        "mask_equip_place": jnp.ones((4, 10), bool),
+        "mask_destroy": jnp.ones((4, 10), bool),
+    }
+    # Force the craft action (15): the craft argument must obey its mask.
+    mask = {**base, "mask_action_type": jnp.zeros((4, 19), bool).at[:, 15].set(True)}
+    for seed in range(5):
+        actions, _ = actor.apply(params, jnp.ones((4, 16)), jax.random.PRNGKey(seed), False, mask)
+        assert (np.asarray(actions[1].argmax(-1)) == 2).all()
+    # Force a movement action (1): the craft argument is unconstrained.
+    mask = {**base, "mask_action_type": jnp.zeros((4, 19), bool).at[:, 1].set(True)}
+    seen = set()
+    for seed in range(20):
+        actions, _ = actor.apply(params, jnp.ones((4, 16)), jax.random.PRNGKey(seed), False, mask)
+        seen.update(np.asarray(actions[1].argmax(-1)).tolist())
+    assert len(seen) > 1, "craft head should be unconstrained for non-craft actions"
+
+
+def test_destroy_mask_applies_for_destroy_action():
+    actor, params = _build()
+    mask = {
+        "mask_action_type": jnp.zeros((4, 19), bool).at[:, 18].set(True),  # destroy only
+        "mask_craft_smelt": jnp.ones((4, 6), bool),
+        "mask_equip_place": jnp.zeros((4, 10), bool).at[:, 1].set(True),
+        "mask_destroy": jnp.zeros((4, 10), bool).at[:, 7].set(True),
+    }
+    for seed in range(5):
+        actions, _ = actor.apply(params, jnp.ones((4, 16)), jax.random.PRNGKey(seed), False, mask)
+        assert (np.asarray(actions[2].argmax(-1)) == 7).all()
