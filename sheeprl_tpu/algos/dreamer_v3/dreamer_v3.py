@@ -59,7 +59,7 @@ from sheeprl_tpu.distributions import (
 )
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
+from sheeprl_tpu.utils.metric import MetricAggregator, make_aggregator, record_episode_stats
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio
@@ -363,7 +363,8 @@ def main(ctx, cfg) -> None:
     )
     rb.seed(cfg.seed + rank)
 
-    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    # rank-independent (cross-process gathering) when multi-host
+    aggregator = make_aggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
@@ -607,5 +608,9 @@ def main(ctx, cfg) -> None:
         reward = test(player_step, params, player_state_init, ctx, cfg, log_dir)
         if logger is not None:
             logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if not cfg.get("model_manager", {}).get("disabled", True) and ctx.is_global_zero:
+        from sheeprl_tpu.utils.model_manager import maybe_register_models
+
+        maybe_register_models(cfg, log_dir)
     if logger is not None:
         logger.close()

@@ -354,5 +354,9 @@ def main(ctx, cfg) -> None:
         reward = test(agent, params, ctx, cfg, log_dir)
         if logger is not None:
             logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if not cfg.get("model_manager", {}).get("disabled", True) and ctx.is_global_zero:
+        from sheeprl_tpu.utils.model_manager import maybe_register_models
+
+        maybe_register_models(cfg, log_dir)
     if logger is not None:
         logger.close()

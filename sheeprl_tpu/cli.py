@@ -132,21 +132,18 @@ def run(args: Optional[List[str]] = None) -> None:
     run_algorithm(cfg)
 
 
-def evaluate(args: Optional[List[str]] = None) -> None:
-    """Eval entry: ``python -m sheeprl_tpu.eval checkpoint_path=... [overrides]``"""
-    _import_algorithms()
-    overrides = list(args if args is not None else sys.argv[1:])
+def _load_checkpoint_cfg(overrides: List[str], path_key: str) -> tuple:
+    """Extract ``<path_key>=...`` from the overrides, load the checkpoint run's
+    config.yaml and apply the remaining overrides on top (reference ``cli.py:369-401``)."""
     ckpt = None
     rest = []
     for ov in overrides:
-        if ov.startswith("checkpoint_path="):
+        if ov.startswith(f"{path_key}="):
             ckpt = ov.split("=", 1)[1]
         else:
             rest.append(ov)
     if ckpt is None:
-        raise ValueError("evaluation requires checkpoint_path=<path>")
-    # The checkpoint's saved config is the base; CLI overrides are applied on top
-    # (reference ``cli.py:369-401``: load ckpt config.yaml + merge).
+        raise ValueError(f"this entry point requires {path_key}=<path>")
     ckpt_path = Path(ckpt)
     run_dir = ckpt_path.parent.parent if ckpt_path.is_dir() else ckpt_path.parent
     cfg_path = run_dir / "config.yaml"
@@ -155,16 +152,44 @@ def evaluate(args: Optional[List[str]] = None) -> None:
     if not cfg_path.is_file():
         raise FileNotFoundError(f"No config.yaml found alongside checkpoint {ckpt}")
     cfg = load_config(cfg_path)
+    from sheeprl_tpu.config.core import _parse_value, _set_dotted
+
     for ov in rest:
         if "=" not in ov:
             raise ValueError(f"Malformed override {ov!r}")
         key, _, val = ov.partition("=")
-        from sheeprl_tpu.config.core import _parse_value, _set_dotted
-
         _set_dotted(cfg, key.lstrip("+"), _parse_value(val))
-    cfg = DotDict.wrap(cfg)
-    cfg.checkpoint_path = ckpt
+    return DotDict.wrap(cfg), ckpt_path
+
+
+def evaluate(args: Optional[List[str]] = None) -> None:
+    """Eval entry: ``python -m sheeprl_tpu.eval checkpoint_path=... [overrides]``"""
+    _import_algorithms()
+    overrides = list(args if args is not None else sys.argv[1:])
+    cfg, ckpt_path = _load_checkpoint_cfg(overrides, "checkpoint_path")
+    cfg.checkpoint_path = str(ckpt_path)
     eval_algorithm(cfg)
+
+
+def registration(args: Optional[List[str]] = None) -> None:
+    """Model-registration entry (reference ``cli.py:408`` / ``sheeprl-registration``):
+    ``python -m sheeprl_tpu.registration checkpoint_path=<ckpt_dir> [model_manager.name=...]``
+    registers a training checkpoint's models in the configured registry."""
+    from sheeprl_tpu.utils.model_manager import build_model_manager
+
+    overrides = list(args if args is not None else sys.argv[1:])
+    cfg, ckpt_path = _load_checkpoint_cfg(overrides, "checkpoint_path")
+
+    mm_cfg = cfg.get("model_manager", {}) or {}
+    name = mm_cfg.get("name") or f"{cfg.algo.name}_{cfg.env.id}"
+    manager = build_model_manager(cfg)
+    version = manager.register_model(
+        str(ckpt_path),
+        name,
+        model_keys=list(mm_cfg.get("models", {}) or []),
+        metadata={"algo": cfg.algo.name, "env": cfg.env.id, "seed": cfg.seed},
+    )
+    print(f"Registered {name} version {version}")
 
 
 def available_algorithms() -> List[str]:

@@ -87,7 +87,11 @@ class MineRLWrapper(gym.Env):
         self._multihot = multihot_inventory
         if "navigate" not in id.lower():
             kwargs.pop("extreme", None)
-        self._env = CUSTOM_ENVS[id.lower()](break_speed=break_speed_multiplier, **kwargs).make()
+        self._env = CUSTOM_ENVS[id.lower()](
+            break_speed=break_speed_multiplier, resolution=(height, width), **kwargs
+        ).make()
+        if seed is not None and hasattr(self._env, "seed"):
+            self._env.seed(seed)
 
         # Discrete action table: index 0 = no-op; binary keys contribute one entry,
         # the camera four (±15° pitch/yaw), enum actions one per non-"none" value.

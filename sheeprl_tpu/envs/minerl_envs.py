@@ -256,9 +256,11 @@ class CustomObtain(_TpuEmbodimentSpec):
         return f"Obtain a {self.target_item}; rewarded {cadence} along the item hierarchy."
 
     def determine_success_from_rewards(self, rewards: list) -> bool:
-        # Success = the run hit (almost) every milestone reward at least once.
-        reward_values = [entry["reward"] for entry in self.reward_schedule]
-        max_missing = round(len(self.reward_schedule) * 0.1)
+        # Success = the run hit (almost) every milestone reward at least once.  Counted
+        # over UNIQUE reward values: the schedule reuses 4 and 32, so the reference's
+        # len(schedule)-based threshold (obtain.py:160-169) could never be met.
+        reward_values = {entry["reward"] for entry in self.reward_schedule}
+        max_missing = round(len(reward_values) * 0.1)
         return len(set(rewards).intersection(reward_values)) >= len(reward_values) - max_missing
 
 

@@ -111,6 +111,80 @@ class MetricAggregator:
             m.reset()
 
 
+class RankIndependentMetricAggregator:
+    """Per-rank metrics with a cross-process gather at compute time
+    (reference ``metric.py:146-195``).
+
+    Each process accumulates its own values; ``compute()`` all-gathers the per-rank
+    results over DCN via ``multihost_utils.process_allgather`` and returns the
+    cross-rank MEAN of each metric (every rank sees the same values, like the
+    reference's broadcast-back).  ``compute_per_rank()`` exposes the raw
+    ``[world_size]`` vectors."""
+
+    def __init__(self, metrics: Optional[Dict[str, Any] | MetricAggregator] = None):
+        self._aggregator = metrics if isinstance(metrics, MetricAggregator) else MetricAggregator(metrics)
+
+    @property
+    def metrics(self) -> Dict[str, Any]:
+        return self._aggregator.metrics
+
+    def add(self, name: str, metric: Any = "mean") -> None:
+        self._aggregator.add(name, metric)
+
+    def update(self, name: str, value: Any) -> None:
+        self._aggregator.update(name, value)
+
+    def keep(self, keys: Iterable[str]) -> None:
+        """Prune AND pre-register the whitelist: every rank must carry the SAME metric
+        name set or the fixed-shape cross-process gather breaks (lazy registration via
+        update() would make the set rank-dependent, e.g. Rewards/rew_avg appearing only
+        on ranks that finished an episode)."""
+        self._aggregator.keep(keys)
+        for k in sorted(keys):
+            if k not in self._aggregator.metrics:
+                self._aggregator.add(k)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._aggregator
+
+    def compute_per_rank(self) -> Dict[str, np.ndarray]:
+        """Gather each metric's local value from every process → ``[world]`` arrays.
+        Absent-on-this-rank metrics gather as NaN so ranks stay aligned."""
+        import jax
+
+        local = self._aggregator.compute()
+        if jax.process_count() == 1:
+            return {k: np.asarray([v]) for k, v in local.items()}
+        from jax.experimental import multihost_utils
+
+        # One fixed-order vector per rank keeps the gather shape static across ranks.
+        names = sorted(self._aggregator.metrics)
+        vec = np.asarray([local.get(n, np.nan) for n in names], dtype=np.float64)
+        gathered = np.asarray(multihost_utils.process_allgather(vec))  # [world, n_metrics]
+        return {n: gathered[:, i] for i, n in enumerate(names)}
+
+    def compute(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name, values in self.compute_per_rank().items():
+            finite = values[np.isfinite(values)]
+            if finite.size:
+                out[name] = float(finite.mean())
+        return out
+
+    def reset(self) -> None:
+        self._aggregator.reset()
+
+
+def make_aggregator(metrics: Optional[Dict[str, Any]] = None):
+    """MetricAggregator, rank-aware when running multi-process (reference picks
+    ``RankIndependentMetricAggregator`` for cross-rank metrics)."""
+    import jax
+
+    if jax.process_count() > 1:
+        return RankIndependentMetricAggregator(metrics)
+    return MetricAggregator(metrics)
+
+
 def record_episode_stats(aggregator: MetricAggregator, info: Dict[str, Any]) -> None:
     """Feed ``RecordEpisodeStatistics`` vector-env info into the aggregator.
 

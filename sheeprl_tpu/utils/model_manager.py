@@ -1,0 +1,200 @@
+"""Model registry (reference: ``/root/reference/sheeprl/utils/mlflow.py:75-328`` +
+registration CLI ``cli.py:408``).
+
+Two backends behind one API:
+
+* ``LocalModelManager`` — a filesystem registry (JSON index + copied checkpoint
+  payloads under ``<registry_dir>``).  The TPU-native default: works on any shared
+  filesystem with zero extra services, which is how multi-host TPU jobs usually share
+  artifacts.
+* ``MlflowModelManager`` — mirrors the reference's MLflow registry operations
+  (register / transition / delete / download) when ``mlflow`` is installed.
+
+Both expose: ``register_model(ckpt_path, name, model_keys, metadata)``,
+``get_models()``, ``transition_model(name, version, stage)``, ``delete_model(name,
+version)`` and ``download_model(name, version, output_dir)``.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+REGISTRY_INDEX = "registry.json"
+
+
+class LocalModelManager:
+    def __init__(self, registry_dir: str = "models_registry"):
+        self.registry_dir = Path(registry_dir)
+        self.registry_dir.mkdir(parents=True, exist_ok=True)
+        self._index_path = self.registry_dir / REGISTRY_INDEX
+
+    # -- index ---------------------------------------------------------------
+    def _load(self) -> Dict[str, Any]:
+        if self._index_path.is_file():
+            with open(self._index_path) as f:
+                return json.load(f)
+        return {}
+
+    def _save(self, index: Dict[str, Any]) -> None:
+        tmp = self._index_path.with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            json.dump(index, f, indent=2)
+        tmp.replace(self._index_path)
+
+    # -- API -----------------------------------------------------------------
+    def register_model(
+        self,
+        ckpt_path: str,
+        name: str,
+        model_keys: Optional[List[str]] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> int:
+        """Copy the checkpoint payload into the registry as a new version of ``name``
+        (reference ``register_model``, ``mlflow.py:75-150``)."""
+        index = self._load()
+        entry = index.setdefault(name, {"versions": []})
+        version = len(entry["versions"]) + 1
+        dest = self.registry_dir / name / f"v{version}"
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        src = Path(ckpt_path)
+        if src.is_dir():
+            shutil.copytree(src, dest, dirs_exist_ok=True)
+        else:
+            dest.mkdir(parents=True, exist_ok=True)
+            shutil.copy2(src, dest / src.name)
+        entry["versions"].append(
+            {
+                "version": version,
+                "path": str(dest),
+                "source_checkpoint": str(src),
+                "model_keys": list(model_keys or []),
+                "stage": "None",
+                "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "metadata": metadata or {},
+            }
+        )
+        self._save(index)
+        return version
+
+    def get_models(self) -> Dict[str, Any]:
+        return self._load()
+
+    def _version_entry(self, index, name: str, version: Optional[int]):
+        if name not in index or not index[name]["versions"]:
+            raise ValueError(f"No registered model named {name!r}")
+        versions = index[name]["versions"]
+        if version is None:
+            return versions[-1]
+        for entry in versions:
+            if entry["version"] == version:
+                return entry
+        raise ValueError(f"Model {name!r} has no version {version}")
+
+    def transition_model(self, name: str, version: Optional[int], stage: str) -> None:
+        """Move a version to a stage (staging/production/archived), like the reference's
+        MLflow stage transition (``mlflow.py:152-200``)."""
+        index = self._load()
+        self._version_entry(index, name, version)["stage"] = stage
+        self._save(index)
+
+    def delete_model(self, name: str, version: Optional[int] = None) -> None:
+        index = self._load()
+        if version is None:
+            for entry in index.get(name, {}).get("versions", []):
+                shutil.rmtree(entry["path"], ignore_errors=True)
+            index.pop(name, None)
+        else:
+            entry = self._version_entry(index, name, version)
+            shutil.rmtree(entry["path"], ignore_errors=True)
+            index[name]["versions"] = [e for e in index[name]["versions"] if e["version"] != version]
+        self._save(index)
+
+    def download_model(self, name: str, version: Optional[int], output_dir: str) -> Path:
+        index = self._load()
+        entry = self._version_entry(index, name, version)
+        dest = Path(output_dir) / name / f"v{entry['version']}"
+        shutil.copytree(entry["path"], dest, dirs_exist_ok=True)
+        return dest
+
+
+class MlflowModelManager:
+    """Reference-parity MLflow backend (``mlflow.py:75-328``); requires ``mlflow``."""
+
+    def __init__(self, tracking_uri: Optional[str] = None):
+        if not _IS_MLFLOW_AVAILABLE:
+            raise ModuleNotFoundError("mlflow is not installed; use LocalModelManager instead")
+        import mlflow
+
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        self._mlflow = mlflow
+        self._client = mlflow.MlflowClient()
+
+    def register_model(self, ckpt_path, name, model_keys=None, metadata=None) -> int:
+        with self._mlflow.start_run(run_name=f"register_{name}") as run:
+            self._mlflow.log_artifacts(str(ckpt_path), artifact_path="checkpoint")
+            if metadata:
+                self._mlflow.log_params({k: str(v) for k, v in metadata.items()})
+            version = self._mlflow.register_model(f"runs:/{run.info.run_id}/checkpoint", name)
+        return int(version.version)
+
+    def get_models(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for model in self._client.search_registered_models():
+            out[model.name] = {
+                "versions": [
+                    {"version": int(v.version), "stage": v.current_stage, "path": v.source}
+                    for v in model.latest_versions
+                ]
+            }
+        return out
+
+    def transition_model(self, name, version, stage) -> None:
+        self._client.transition_model_version_stage(name, str(version), stage)
+
+    def delete_model(self, name, version=None) -> None:
+        if version is None:
+            self._client.delete_registered_model(name)
+        else:
+            self._client.delete_model_version(name, str(version))
+
+    def download_model(self, name, version, output_dir) -> Path:
+        import mlflow.artifacts
+
+        uri = f"models:/{name}/{version}"
+        return Path(mlflow.artifacts.download_artifacts(artifact_uri=uri, dst_path=output_dir))
+
+
+def build_model_manager(cfg) -> LocalModelManager | MlflowModelManager:
+    mm_cfg = cfg.get("model_manager", {}) or {}
+    backend = str(mm_cfg.get("backend", "local")).lower()
+    if backend == "mlflow":
+        return MlflowModelManager(tracking_uri=mm_cfg.get("tracking_uri"))
+    return LocalModelManager(registry_dir=mm_cfg.get("registry_dir", "models_registry"))
+
+
+def maybe_register_models(cfg, log_dir: str) -> Optional[int]:
+    """End-of-training registration hook (reference calls ``register_model`` at the end
+    of every algo main, e.g. ``dreamer_v3.py:769-780``)."""
+    mm_cfg = cfg.get("model_manager", {}) or {}
+    if mm_cfg.get("disabled", True):
+        return None
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+
+    ckpts = CheckpointManager(Path(log_dir) / "checkpoints").list_checkpoints()
+    if not ckpts:
+        return None
+    name = mm_cfg.get("name") or f"{cfg.algo.name}_{cfg.env.id}"
+    manager = build_model_manager(cfg)
+    return manager.register_model(
+        str(ckpts[-1]),
+        name,
+        model_keys=list(mm_cfg.get("models", {}) or []),
+        metadata={"algo": cfg.algo.name, "env": cfg.env.id, "seed": cfg.seed, "run_name": cfg.get("run_name", "")},
+    )

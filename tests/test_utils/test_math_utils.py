@@ -109,3 +109,16 @@ def test_ratio_state_dict_roundtrip():
     state = r.state_dict()
     r2 = Ratio(1.0).load_state_dict(state)
     assert r2.state_dict() == state
+
+
+def test_rank_independent_aggregator_single_process():
+    from sheeprl_tpu.utils.metric import RankIndependentMetricAggregator
+
+    agg = RankIndependentMetricAggregator()
+    agg.update("Loss/a", 1.0)
+    agg.update("Loss/a", 3.0)
+    per_rank = agg.compute_per_rank()
+    assert per_rank["Loss/a"].shape == (1,)
+    assert agg.compute()["Loss/a"] == 2.0
+    agg.reset()
+    assert agg.compute() == {}

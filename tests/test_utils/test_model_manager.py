@@ -1,0 +1,86 @@
+"""Model registry tests (reference utils/mlflow.py:75-328 surface on the local backend)."""
+
+import json
+
+from sheeprl_tpu.utils.model_manager import LocalModelManager
+
+
+def _make_ckpt(tmp_path, name="ckpt_10"):
+    ckpt = tmp_path / name
+    ckpt.mkdir()
+    (ckpt / "params.msgpack").write_bytes(b"abc")
+    return ckpt
+
+
+def test_register_get_transition_delete_download(tmp_path):
+    mm = LocalModelManager(registry_dir=tmp_path / "registry")
+    ckpt = _make_ckpt(tmp_path)
+
+    v1 = mm.register_model(str(ckpt), "dreamer_v3_pacman", model_keys=["world_model"], metadata={"seed": 1})
+    v2 = mm.register_model(str(ckpt), "dreamer_v3_pacman")
+    assert (v1, v2) == (1, 2)
+
+    models = mm.get_models()
+    assert len(models["dreamer_v3_pacman"]["versions"]) == 2
+    assert models["dreamer_v3_pacman"]["versions"][0]["model_keys"] == ["world_model"]
+
+    mm.transition_model("dreamer_v3_pacman", 2, "production")
+    assert mm.get_models()["dreamer_v3_pacman"]["versions"][1]["stage"] == "production"
+
+    out = mm.download_model("dreamer_v3_pacman", 2, str(tmp_path / "dl"))
+    assert (out / "params.msgpack").read_bytes() == b"abc"
+
+    mm.delete_model("dreamer_v3_pacman", 1)
+    assert len(mm.get_models()["dreamer_v3_pacman"]["versions"]) == 1
+    mm.delete_model("dreamer_v3_pacman")
+    assert "dreamer_v3_pacman" not in mm.get_models()
+
+
+def test_registry_index_is_json(tmp_path):
+    mm = LocalModelManager(registry_dir=tmp_path / "registry")
+    mm.register_model(str(_make_ckpt(tmp_path)), "m")
+    with open(tmp_path / "registry" / "registry.json") as f:
+        idx = json.load(f)
+    assert idx["m"]["versions"][0]["version"] == 1
+
+
+def test_registration_cli_roundtrip(tmp_path, monkeypatch):
+    """Train a tiny PPO run, then register its checkpoint via the CLI entry."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu.cli import registration, run
+
+    run(
+        [
+            "exp=ppo",
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "dry_run=True",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "checkpoint.every=1",
+            "checkpoint.save_last=True",
+            "metric.log_every=1",
+            f"log_root={tmp_path}",
+            "buffer.memmap=False",
+        ]
+    )
+    ckpts = sorted(tmp_path.rglob("ckpt_*"), key=lambda p: p.stat().st_mtime)
+    assert ckpts
+    registration(
+        [
+            f"checkpoint_path={ckpts[-1]}",
+            "model_manager.disabled=False",
+            f"model_manager.registry_dir={tmp_path}/registry",
+            "model_manager.name=ppo_test",
+        ]
+    )
+    from sheeprl_tpu.utils.model_manager import LocalModelManager
+
+    mm = LocalModelManager(registry_dir=tmp_path / "registry")
+    assert mm.get_models()["ppo_test"]["versions"][0]["version"] == 1
