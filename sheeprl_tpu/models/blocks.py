@@ -160,6 +160,10 @@ class LayerNormGRUCell(nn.Module):
     One matmul computes all three gates from ``[input, hidden]`` — a single large MXU op
     instead of six small ones.  The update gate gets a ``-1`` bias (Hafner) so the cell
     starts out remembering.
+
+    The post-matmul chain (LayerNorm + gates + state blend) can run as ONE fused Pallas
+    VMEM pass (``sheeprl_tpu/ops/gru.py``) — enable with ``SHEEPRL_TPU_FUSED_GRU=1``
+    (same param tree either way; the kernel consumes this cell's ``ln_scale``/``ln_bias``).
     """
 
     hidden_size: int
@@ -169,10 +173,26 @@ class LayerNormGRUCell(nn.Module):
 
     @nn.compact
     def __call__(self, h: jax.Array, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        from sheeprl_tpu.ops import fused_gru_enabled
+
         inp = jnp.concatenate([x, h], axis=-1).astype(self.dtype)
         fused = nn.Dense(3 * self.hidden_size, use_bias=not self.layer_norm, dtype=self.dtype)(inp)
         if self.layer_norm:
-            fused = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.dtype)(fused)
+            # NOTE: ln_scale/ln_bias replaced the earlier nn.LayerNorm child module, so
+            # the param tree changed (checkpoints from before this cell revision need a
+            # LayerNorm_0/{scale,bias} -> ln_scale/ln_bias rename).
+            gamma = self.param("ln_scale", nn.initializers.ones, (3 * self.hidden_size,), jnp.float32)
+            beta = self.param("ln_bias", nn.initializers.zeros, (3 * self.hidden_size,), jnp.float32)
+            h_cast = h.astype(self.dtype)
+            if fused_gru_enabled() and fused.ndim == 2:
+                from sheeprl_tpu.ops.gru import fused_layernorm_gru
+
+                h_new = fused_layernorm_gru(fused, h_cast, gamma, beta, self.norm_eps)
+            else:
+                from sheeprl_tpu.ops.gru import reference_layernorm_gru
+
+                h_new = reference_layernorm_gru(fused, h_cast, gamma, beta, self.norm_eps)
+            return h_new, h_new
         reset, cand, update = jnp.split(fused, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)
