@@ -1,0 +1,34 @@
+"""Wall-clock benchmark harness (reference: ``/root/reference/benchmarks/benchmark.py``).
+
+Runs a ``*_benchmarks`` experiment end-to-end through the real CLI and prints the
+elapsed seconds — the number the reference's README SB3-comparison table reports
+(BASELINE.md).  Unlike the reference (edit-the-source to switch algorithms), the
+experiment is a CLI argument:
+
+    python benchmarks/benchmark.py exp=ppo_benchmarks
+    python benchmarks/benchmark.py exp=dreamer_v3_benchmarks mesh.devices=8
+
+Extra ``key=value`` overrides pass straight through to the config system.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if os.environ.get("JAX_PLATFORMS"):
+        # Honor the env var even where site config pins the platform at startup.
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from sheeprl_tpu.cli import run
+
+    args = sys.argv[1:]
+    if not any(a.startswith("exp=") for a in args):
+        args = ["exp=ppo_benchmarks", *args]
+    tic = time.perf_counter()
+    run(args)
+    print(f"{time.perf_counter() - tic:.2f}")
