@@ -7,6 +7,7 @@ at the first gradient step."""
 
 from __future__ import annotations
 
+import contextlib
 import time
 from pathlib import Path
 from typing import Dict
@@ -23,6 +24,7 @@ from sheeprl_tpu.algos.p2e_dv2.p2e_dv2_exploration import make_train_step as mak
 from sheeprl_tpu.algos.p2e_dv2.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
+from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -157,6 +159,24 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             row[k] = v.reshape(1, v.shape[0], -1)
         return row
 
+    # Double-buffered sampling: the next [G, T, B] block is drawn + shipped to the
+    # device while the current block's gradient steps execute (SURVEY §7).
+    def _sample_block(n: int):
+        return rb.sample_tensors(
+            batch_size,
+            sequence_length=seq_len,
+            n_samples=n,
+            dtype=None,
+            sharding=(
+                ctx.batch_sharding(2)
+                if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
+                else None
+            ),
+        )
+
+    prefetcher = AsyncBatchPrefetcher(_sample_block) if cfg.algo.get("async_prefetch", True) else None
+    rb_lock = prefetcher.lock if prefetcher is not None else contextlib.nullcontext()
+
     obs, _ = envs.reset(seed=cfg.seed + rank)
     player_state = player_state_init(num_envs)
     step_data: Dict[str, np.ndarray] = _obs_row(obs)
@@ -187,7 +207,8 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
                 env_actions = np.stack([a.argmax(-1) for a in acts_np], -1)
 
             step_data["actions"] = stored_actions.reshape(1, num_envs, -1)
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            with rb_lock:
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
             next_obs, reward, terminated, truncated, info = envs.step(env_actions)
             if cfg.env.clip_rewards:
@@ -216,7 +237,8 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
                 reset_data["truncated"] = step_data["truncated"][:, done_idxs]
                 reset_data["actions"] = np.zeros((1, len(done_idxs), act_dim_sum), np.float32)
                 reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-                rb.add(reset_data, done_idxs, validate_args=cfg.buffer.validate_args)
+                with rb_lock:
+                    rb.add(reset_data, done_idxs, validate_args=cfg.buffer.validate_args)
                 step_data["rewards"][:, done_idxs] = 0.0
                 step_data["terminated"][:, done_idxs] = 0.0
                 step_data["truncated"][:, done_idxs] = 0.0
@@ -237,16 +259,10 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             if grad_steps > 0:
                 with timer("Time/train_time"):
                     t0 = time.perf_counter()
-                    sample = rb.sample_tensors(
-                        batch_size,
-                        sequence_length=seq_len,
-                        n_samples=grad_steps,
-                        dtype=None,
-                        sharding=(
-                            ctx.batch_sharding(2)
-                            if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
-                            else None
-                        ),
+                    sample = (
+                        prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
+                        if prefetcher is not None
+                        else _sample_block(grad_steps)
                     )
                     view = task_view(params)
                     for g in range(grad_steps):
@@ -305,6 +321,8 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             last_checkpoint = policy_step
 
     envs.close()
+    if prefetcher is not None:
+        prefetcher.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(
             player_step,

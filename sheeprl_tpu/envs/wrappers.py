@@ -125,7 +125,14 @@ class FrameStack(gym.Wrapper):
 
 class RestartOnException(gym.Wrapper):
     """Rebuild the env when step/reset raises (reference ``:74-124``); used for flaky
-    envs (MineRL-style). At most ``maxfails`` rebuilds per ``window`` seconds."""
+    envs (MineRL-style). At most ``maxfails`` rebuilds per ``window`` seconds.
+
+    Restart semantics differ deliberately from the reference: a restart is surfaced as
+    ``truncated=True`` (+ ``info["restart_on_exception"]``), so every training loop's
+    ordinary done path marks the replay-buffer episode boundary (truncated row +
+    ``is_first`` on the next row).  The reference instead returns ``done=False`` and
+    has DreamerV3 patch the buffer after the fact (``dreamer_v3.py:595-608``) — a
+    repair step each consumer must remember; here consistency holds by construction."""
 
     def __init__(self, env_fn: Callable[[], gym.Env], maxfails: int = 5, window: float = 60.0):
         self._env_fn = env_fn

@@ -118,3 +118,38 @@ def test_make_env_unknown_keys_raise():
     cfg = _pipeline_cfg("discrete_dummy", cnn=("nope",), mlp=())
     with pytest.raises(ValueError):
         make_env(cfg, seed=0, rank=0)()
+
+
+def test_restart_on_exception_marks_truncation():
+    """A crashed+restarted env must surface as a truncation so training loops commit
+    the episode boundary to the replay buffer (design note in the wrapper docstring)."""
+    import gymnasium as gym
+    import numpy as np
+
+    from sheeprl_tpu.envs.wrappers import RestartOnException
+
+    class Crashy(gym.Env):
+        observation_space = gym.spaces.Box(-1, 1, (2,), np.float32)
+        action_space = gym.spaces.Discrete(2)
+
+        def __init__(self):
+            self.steps = 0
+
+        def reset(self, seed=None, options=None):
+            return np.zeros(2, np.float32), {}
+
+        def step(self, action):
+            self.steps += 1
+            if self.steps == 2:
+                raise RuntimeError("env crashed")
+            return np.zeros(2, np.float32), 0.0, False, False, {}
+
+    env = RestartOnException(Crashy, maxfails=3, window=60.0)
+    env.reset()
+    env.step(0)
+    obs, reward, terminated, truncated, info = env.step(0)  # crash -> restart
+    assert truncated and not terminated
+    assert info.get("restart_on_exception") is True
+    # the rebuilt env keeps working
+    obs, reward, terminated, truncated, info = env.step(0)
+    assert not truncated and "restart_on_exception" not in info
