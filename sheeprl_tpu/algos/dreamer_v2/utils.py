@@ -19,6 +19,9 @@ AGGREGATOR_KEYS = {
     "Loss/continue_loss",
     "State/kl",
     "State/post_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
     "State/prior_entropy",
 }
 MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}

@@ -18,7 +18,6 @@ shifted right by one inside the train step with a zero first action."""
 
 from __future__ import annotations
 
-import contextlib
 import os
 import time
 from pathlib import Path
@@ -48,7 +47,7 @@ from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.data.prefetch import make_replay_prefetcher
 from sheeprl_tpu.distributions import (
     BernoulliSafeMode,
     Independent,
@@ -308,6 +307,9 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys, obs_sha
         metrics = dict(wm_metrics)
         metrics["Loss/policy_loss"] = policy_loss
         metrics["Loss/value_loss"] = value_loss
+        metrics["Grads/world_model"] = optax.global_norm(wm_grads)
+        metrics["Grads/actor"] = optax.global_norm(actor_grads)
+        metrics["Grads/critic"] = optax.global_norm(critic_grads)
         return new_params, new_opt_states, actor_aux["moments"], metrics
 
     return train_step, init_opt_states
@@ -421,21 +423,7 @@ def main(ctx, cfg) -> None:
 
     # Double-buffered sampling: the next [G, T, B] block is drawn + shipped to the
     # device while the current block's gradient steps execute (SURVEY §7).
-    def _sample_block(n: int):
-        return rb.sample_tensors(
-            batch_size,
-            sequence_length=seq_len,
-            n_samples=n,
-            dtype=None,
-            sharding=(
-                ctx.batch_sharding(2)
-                if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
-                else None
-            ),
-        )
-
-    prefetcher = AsyncBatchPrefetcher(_sample_block) if cfg.algo.get("async_prefetch", True) else None
-    rb_lock = prefetcher.lock if prefetcher is not None else contextlib.nullcontext()
+    prefetcher, rb_lock, _sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
     player_state = player_state_init(num_envs)

@@ -19,6 +19,9 @@ AGGREGATOR_KEYS = {
     "Loss/value_loss_exploration",
     "State/kl",
     "State/post_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
     "State/prior_entropy",
     "Params/exploration_amount",
     "Rewards/intrinsic",

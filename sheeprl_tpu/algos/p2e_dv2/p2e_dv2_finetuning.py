@@ -7,7 +7,6 @@ at the first gradient step."""
 
 from __future__ import annotations
 
-import contextlib
 import time
 from pathlib import Path
 from typing import Dict
@@ -24,7 +23,7 @@ from sheeprl_tpu.algos.p2e_dv2.p2e_dv2_exploration import make_train_step as mak
 from sheeprl_tpu.algos.p2e_dv2.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
-from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.data.prefetch import make_replay_prefetcher
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -161,21 +160,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
 
     # Double-buffered sampling: the next [G, T, B] block is drawn + shipped to the
     # device while the current block's gradient steps execute (SURVEY §7).
-    def _sample_block(n: int):
-        return rb.sample_tensors(
-            batch_size,
-            sequence_length=seq_len,
-            n_samples=n,
-            dtype=None,
-            sharding=(
-                ctx.batch_sharding(2)
-                if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
-                else None
-            ),
-        )
-
-    prefetcher = AsyncBatchPrefetcher(_sample_block) if cfg.algo.get("async_prefetch", True) else None
-    rb_lock = prefetcher.lock if prefetcher is not None else contextlib.nullcontext()
+    prefetcher, rb_lock, _sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
     player_state = player_state_init(num_envs)

@@ -21,7 +21,6 @@ The env-interaction loop is the DV3 one; the player acts with the exploration ac
 
 from __future__ import annotations
 
-import contextlib
 import os
 import time
 from pathlib import Path
@@ -47,7 +46,7 @@ from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.data.prefetch import make_replay_prefetcher
 from sheeprl_tpu.distributions import (
     BernoulliSafeMode,
     Independent,
@@ -524,21 +523,7 @@ def main(ctx, cfg) -> None:
 
     # Double-buffered sampling: the next [G, T, B] block is drawn + shipped to the
     # device while the current block's gradient steps execute (SURVEY §7).
-    def _sample_block(n: int):
-        return rb.sample_tensors(
-            batch_size,
-            sequence_length=seq_len,
-            n_samples=n,
-            dtype=None,
-            sharding=(
-                ctx.batch_sharding(2)
-                if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
-                else None
-            ),
-        )
-
-    prefetcher = AsyncBatchPrefetcher(_sample_block) if cfg.algo.get("async_prefetch", True) else None
-    rb_lock = prefetcher.lock if prefetcher is not None else contextlib.nullcontext()
+    prefetcher, rb_lock, _sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
     player_state = player_state_init(num_envs)

@@ -23,6 +23,9 @@ AGGREGATOR_KEYS = {
     "Loss/policy_loss_exploration",
     "State/kl",
     "State/post_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
     "State/prior_entropy",
 }
 MODELS_TO_REGISTER = {

@@ -34,18 +34,48 @@ class CheckpointManager:
         self.ckpt_dir = Path(ckpt_dir)
         self.keep_last = keep_last
 
+    # Host-local state saved by EVERY process under a rank suffix.  The reference
+    # gathers per-rank replay buffers to rank-0 over gloo (callback.py:42-51); on TPU
+    # pods the shared filesystem IS the gather — each host writes its own shard and
+    # reads it back on resume, with zero DCN traffic.
+    PER_RANK_KEYS = ("rb",)
+
+    @staticmethod
+    def _barrier(name: str) -> None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+
     def save(self, step: int, state: Dict[str, Any]) -> Path:
-        """``state`` maps names to either device pytrees or picklable host objects."""
-        if jax.process_index() != 0:
-            return self.ckpt_dir / f"ckpt_{step}"
+        """``state`` maps names to either device pytrees or picklable host objects.
+        Entries named in ``PER_RANK_KEYS`` are written by every process
+        (``<name>.rank<k>.pkl``); everything else by process 0 only.
+
+        Multi-host protocol: rank 0 builds the directory and atomically renames it
+        into place, a global barrier publishes it, THEN the other ranks drop their
+        shards in — no writer ever races the rename."""
         out = self.ckpt_dir / f"ckpt_{step}"
+        rank = jax.process_index()
+        if rank != 0:
+            per_rank = {k: v for k, v in state.items() if k in self.PER_RANK_KEYS}
+            self._barrier(f"ckpt_{step}_published")  # rank 0 has renamed tmp -> out
+            for name, value in per_rank.items():
+                with open(out / f"{name}.rank{rank}.pkl", "wb") as f:
+                    pickle.dump(value, f)
+            self._barrier(f"ckpt_{step}_shards")
+            return out
         tmp = self.ckpt_dir / f".tmp_ckpt_{step}"
         if tmp.exists():
             shutil.rmtree(tmp)
         tmp.mkdir(parents=True)
         manifest: Dict[str, str] = {}
         for name, value in state.items():
-            if _is_device_tree(value):
+            if name in self.PER_RANK_KEYS:
+                with open(tmp / f"{name}.rank0.pkl", "wb") as f:
+                    pickle.dump(value, f)
+                manifest[name] = "per_rank"
+            elif _is_device_tree(value):
                 host_value = jax.device_get(value)
                 (tmp / f"{name}.msgpack").write_bytes(serialization.to_bytes(host_value))
                 manifest[name] = "msgpack"
@@ -61,6 +91,8 @@ class CheckpointManager:
         if out.exists():
             shutil.rmtree(out)
         tmp.rename(out)
+        self._barrier(f"ckpt_{step}_published")
+        self._barrier(f"ckpt_{step}_shards")  # all ranks' shards are on disk
         self._gc()
         return out
 
@@ -92,6 +124,14 @@ class CheckpointManager:
                     state[name] = serialization.from_bytes(templates[name], raw)
                 else:
                     state[name] = serialization.msgpack_restore(raw)
+            elif kind == "per_rank":
+                # Each process restores its own shard; fall back to rank 0's when the
+                # world size changed between save and resume.
+                shard = ckpt_path / f"{name}.rank{jax.process_index()}.pkl"
+                if not shard.is_file():
+                    shard = ckpt_path / f"{name}.rank0.pkl"
+                with open(shard, "rb") as f:
+                    state[name] = pickle.load(f)
             else:
                 with open(ckpt_path / f"{name}.pkl", "rb") as f:
                     state[name] = pickle.load(f)

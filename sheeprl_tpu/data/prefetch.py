@@ -83,3 +83,29 @@ class AsyncBatchPrefetcher:
             self._req.put_nowait(None)
         except queue.Full:
             pass
+
+
+def make_replay_prefetcher(rb, ctx, cfg, batch_size: int, sequence_length: int):
+    """The training loops' standard setup: a sampler closure drawing
+    ``[n, T, B]`` blocks sharded over the ``data`` mesh axis, wrapped in a prefetcher
+    when ``algo.async_prefetch`` is on.  Returns ``(prefetcher_or_None, rb_lock,
+    sample_block)`` — loops must take ``rb_lock`` around every ``rb.add``."""
+    import contextlib
+
+    def sample_block(n: int):
+        return rb.sample_tensors(
+            batch_size,
+            sequence_length=sequence_length,
+            n_samples=n,
+            dtype=None,
+            sharding=(
+                ctx.batch_sharding(2)
+                if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
+                else None
+            ),
+        )
+
+    if cfg.algo.get("async_prefetch", True):
+        prefetcher = AsyncBatchPrefetcher(sample_block)
+        return prefetcher, prefetcher.lock, sample_block
+    return None, contextlib.nullcontext(), sample_block
