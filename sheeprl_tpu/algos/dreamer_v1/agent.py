@@ -26,6 +26,7 @@ from sheeprl_tpu.algos.dreamer_v2.agent import (
     CNNDecoderV2,
     CriticV2,
     EncoderV2,
+    MinedojoActorV2,
     MLPDecoderV2,
     _xavier_normal_init,
     add_exploration_noise,
@@ -272,7 +273,9 @@ def build_agent(
         dtype=ctx.compute_dtype,
     )
     latent_size = wm_cfg.stochastic_size + wm_cfg.recurrent_model.recurrent_state_size
-    actor = ActorV2(
+    is_minedojo = "minedojo" in str(cfg.env.get("wrapper", {}).get("_target_", "")).lower()
+    actor_cls = MinedojoActorV2 if is_minedojo else ActorV2
+    actor = actor_cls(
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,
         distribution=cfg.distribution.get("type", "auto"),
@@ -321,6 +324,7 @@ def make_player_step(world_model: WorldModelV1, actor: ActorV2, actions_dim: Seq
     def player_step(params, state: PlayerState, obs, is_first, key, expl_amount=0.0, greedy: bool = False):
         k_repr, k_act, k_expl = jax.random.split(key, 3)
         wm, ap = params["world_model"], params["actor"]
+        mask = {k: v for k, v in obs.items() if k.startswith("mask")} or None
         embed = world_model.apply(wm, obs, method=WorldModelV1.encode)
         recurrent = (1 - is_first) * state.recurrent_state
         stoch = (1 - is_first) * state.stochastic_state
@@ -333,7 +337,7 @@ def make_player_step(world_model: WorldModelV1, actor: ActorV2, actions_dim: Seq
         )
         _, stoch = world_model.apply(wm, recurrent, embed, k_repr, method=WorldModelV1.representation)
         latent = jnp.concatenate([stoch, recurrent], -1)
-        actions, _ = actor.apply(ap, latent, k_act, greedy)
+        actions, _ = actor.apply(ap, latent, k_act, greedy, mask)
         if not greedy:
             actions = add_exploration_noise(actions, jnp.asarray(expl_amount), k_expl, is_continuous)
         stored = jnp.concatenate(actions, -1)

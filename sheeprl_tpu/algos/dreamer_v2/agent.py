@@ -453,7 +453,7 @@ class ActorV2(nn.Module):
     dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False):
+    def __call__(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False, mask=None):
         dist_type = self.distribution
         if dist_type == "auto":
             dist_type = "trunc_normal" if self.is_continuous else "discrete"
@@ -487,6 +487,62 @@ class ActorV2(nn.Module):
             d = OneHotCategoricalStraightThrough(logits)
             dists.append(d)
             actions.append(d.mode if (greedy or k is None) else d.rsample(k))
+        return tuple(actions), tuple(dists)
+
+
+class MinedojoActorV2(nn.Module):
+    """Hierarchical masked MineDojo actor for the DV1/DV2 families (reference
+    ``dreamer_v2/agent.py:577-…``; DV1 reuses it via ``dreamer_v1/agent.py:16-27``).
+    Same conditional-mask scheme as the DV3 ``MinedojoActor`` — vectorized
+    ``jnp.where`` selects instead of the reference's [T, B] python loops — with the
+    family's ELU trunk and no unimix."""
+
+    actions_dim: Sequence[int]  # (action-type, craft-arg, item-arg)
+    is_continuous: bool = False
+    distribution: str = "auto"
+    dense_units: int = 400
+    mlp_layers: int = 4
+    activation: str = "elu"
+    layer_norm: bool = False
+    init_std: float = 0.0
+    min_std: float = 0.1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False, mask=None):
+        if self.is_continuous:
+            raise ValueError("MinedojoActorV2 only supports the functional MultiDiscrete action space")
+        x = MLP(
+            hidden_sizes=(self.dense_units,) * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+        )(state)
+        heads = [nn.Dense(d, dtype=self.dtype, name=f"head_{i}")(x).astype(jnp.float32) for i, d in enumerate(self.actions_dim)]
+        keys = jax.random.split(key, len(heads)) if key is not None else [None] * len(heads)
+        neg_inf = jnp.finfo(jnp.float32).min
+
+        actions, dists = [], []
+        functional_action = None
+        for i, logits in enumerate(heads):
+            if mask is not None:
+                if i == 0:
+                    logits = jnp.where(mask["mask_action_type"], logits, neg_inf)
+                elif i == 1:
+                    is_craft = (functional_action == 15)[..., None]
+                    allowed = jnp.where(is_craft, mask["mask_craft_smelt"], True)
+                    logits = jnp.where(allowed, logits, neg_inf)
+                elif i == 2:
+                    is_equip_place = jnp.logical_or(functional_action == 16, functional_action == 17)[..., None]
+                    is_destroy = (functional_action == 18)[..., None]
+                    allowed = jnp.where(is_equip_place, mask["mask_equip_place"], True)
+                    allowed = jnp.where(is_destroy, mask["mask_destroy"], allowed)
+                    logits = jnp.where(allowed, logits, neg_inf)
+            d = OneHotCategoricalStraightThrough(logits)
+            dists.append(d)
+            actions.append(d.mode if (greedy or keys[i] is None) else d.rsample(keys[i]))
+            if functional_action is None:
+                functional_action = actions[0].argmax(-1)
         return tuple(actions), tuple(dists)
 
 
@@ -602,7 +658,9 @@ def build_agent(
         dtype=ctx.compute_dtype,
     )
     latent_size = wm_cfg.stochastic_size * wm_cfg.discrete_size + wm_cfg.recurrent_model.recurrent_state_size
-    actor = ActorV2(
+    is_minedojo = "minedojo" in str(cfg.env.get("wrapper", {}).get("_target_", "")).lower()
+    actor_cls = MinedojoActorV2 if is_minedojo else ActorV2
+    actor = actor_cls(
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,
         distribution=cfg.distribution.get("type", "auto"),
@@ -653,6 +711,7 @@ def make_player_step(world_model: WorldModelV2, actor: ActorV2, actions_dim: Seq
     def player_step(params, state: PlayerState, obs, is_first, key, expl_amount=0.0, greedy: bool = False):
         k_repr, k_act, k_expl = jax.random.split(key, 3)
         wm, ap = params["world_model"], params["actor"]
+        mask = {k: v for k, v in obs.items() if k.startswith("mask")} or None
         embed = world_model.apply(wm, obs, method=WorldModelV2.encode)
         recurrent = (1 - is_first) * state.recurrent_state
         stoch = (1 - is_first) * state.stochastic_state
@@ -666,7 +725,7 @@ def make_player_step(world_model: WorldModelV2, actor: ActorV2, actions_dim: Seq
         _, stoch_sample = world_model.apply(wm, recurrent, embed, k_repr, method=WorldModelV2.representation)
         stoch = stoch_sample.reshape(*stoch_sample.shape[:-2], -1)
         latent = jnp.concatenate([stoch, recurrent], -1)
-        actions, _ = actor.apply(ap, latent, k_act, greedy)
+        actions, _ = actor.apply(ap, latent, k_act, greedy, mask)
         if not greedy:
             actions = add_exploration_noise(actions, jnp.asarray(expl_amount), k_expl, is_continuous)
         stored = jnp.concatenate(actions, -1)

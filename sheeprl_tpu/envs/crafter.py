@@ -18,10 +18,14 @@ import crafter  # noqa: E402
 class CrafterWrapper(gym.Env):
     metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
 
-    def __init__(self, id: str = "reward", screen_size: Tuple[int, int] | int = (64, 64), seed: Optional[int] = None):
+    def __init__(
+        self, id: str = "crafter_reward", screen_size: Tuple[int, int] | int = (64, 64), seed: Optional[int] = None
+    ):
+        if id not in {"crafter_reward", "crafter_nonreward"}:
+            raise ValueError(f"id must be 'crafter_reward' or 'crafter_nonreward', got {id!r}")
         if isinstance(screen_size, int):
             screen_size = (screen_size, screen_size)
-        self._env = crafter.Env(size=screen_size, reward=(id == "reward"), seed=seed)
+        self._env = crafter.Env(size=screen_size, reward=(id == "crafter_reward"), seed=seed)
         self.observation_space = gym.spaces.Dict(
             {"rgb": gym.spaces.Box(0, 255, (3, *screen_size), np.uint8)}
         )
@@ -38,6 +42,8 @@ class CrafterWrapper(gym.Env):
         return self._obs(obs), reward, terminated, truncated, info
 
     def reset(self, seed=None, options=None):
+        if seed is not None:
+            self._env._seed = seed
         return self._obs(self._env.reset()), {}
 
     def render(self):

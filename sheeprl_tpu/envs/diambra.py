@@ -24,6 +24,10 @@ class DiambraWrapper(gym.Env):
     def __init__(
         self,
         id: str,
+        action_space: str = "DISCRETE",
+        screen_size: int | tuple = 64,
+        grayscale: bool = False,
+        repeat_action: int = 1,
         rank: int = 0,
         diambra_settings: Optional[Dict[str, Any]] = None,
         diambra_wrappers: Optional[Dict[str, Any]] = None,
@@ -33,9 +37,33 @@ class DiambraWrapper(gym.Env):
     ):
         from diambra.arena import EnvironmentSettings, WrappersSettings
 
-        settings = EnvironmentSettings(**(diambra_settings or {}))
-        wrappers = WrappersSettings(**(diambra_wrappers or {}))
-        self._env = diambra.arena.make(id, settings, wrappers, render_mode=render_mode, rank=rank)
+        if isinstance(screen_size, int):
+            screen_size = (screen_size, screen_size)
+        if action_space not in {"DISCRETE", "MULTI_DISCRETE"}:
+            raise ValueError(f"action_space must be 'DISCRETE' or 'MULTI_DISCRETE', got {action_space!r}")
+        diambra_settings = dict(diambra_settings or {})
+        diambra_wrappers = dict(diambra_wrappers or {})
+        role = diambra_settings.pop("role", None)
+        settings = EnvironmentSettings(
+            **{
+                **diambra_settings,
+                "game_id": id,
+                "action_space": getattr(diambra.arena.SpaceTypes, action_space),
+                "n_players": 1,
+                "role": getattr(diambra.arena.Roles, role) if role is not None else None,
+                "render_mode": render_mode,
+            }
+        )
+        if repeat_action > 1:
+            # sticky actions need a 1:1 sim step ratio (reference diambra.py:64-69)
+            settings.step_ratio = 1
+        wrappers = WrappersSettings(**{**diambra_wrappers, "flatten": True, "repeat_action": repeat_action})
+        # resize in-engine when possible: cheaper than a cv2 transform per step
+        if increase_performance:
+            settings.frame_shape = (*screen_size, int(grayscale))
+        else:
+            wrappers.frame_shape = (*screen_size, int(grayscale))
+        self._env = diambra.arena.make(id, settings, wrappers, render_mode=render_mode, rank=rank, log_level=log_level)
         self.action_space = (
             gym.spaces.MultiDiscrete(self._env.action_space.nvec)
             if hasattr(self._env.action_space, "nvec")

@@ -60,3 +60,20 @@ def test_destroy_mask_applies_for_destroy_action():
     for seed in range(5):
         actions, _ = actor.apply(params, jnp.ones((4, 16)), jax.random.PRNGKey(seed), False, mask)
         assert (np.asarray(actions[2].argmax(-1)) == 7).all()
+
+
+def test_minedojo_actor_v2_masking():
+    from sheeprl_tpu.algos.dreamer_v2.agent import MinedojoActorV2
+
+    actor = MinedojoActorV2(actions_dim=(19, 6, 10), dense_units=8, mlp_layers=1)
+    params = actor.init(jax.random.PRNGKey(0), jnp.zeros((4, 16)), jax.random.PRNGKey(1))
+    mask = {
+        "mask_action_type": jnp.zeros((4, 19), bool).at[:, 15].set(True),
+        "mask_craft_smelt": jnp.zeros((4, 6), bool).at[:, 3].set(True),
+        "mask_equip_place": jnp.ones((4, 10), bool),
+        "mask_destroy": jnp.ones((4, 10), bool),
+    }
+    for seed in range(5):
+        actions, _ = actor.apply(params, jnp.ones((4, 16)), jax.random.PRNGKey(seed), False, mask)
+        assert (np.asarray(actions[0].argmax(-1)) == 15).all()
+        assert (np.asarray(actions[1].argmax(-1)) == 3).all()
