@@ -403,7 +403,7 @@ def main(ctx, cfg) -> None:
                         else _sample_block(grad_steps)
                     )
                     for g in range(grad_steps):
-                        batch = {k: v[g] for k, v in sample.items()}
+                        batch = sample[g]
                         cumulative_grad_steps += 1
                         params, opt_states, train_metrics = train_jit(params, opt_states, batch, ctx.rng())
                     train_metrics = jax.device_get(train_metrics)

@@ -537,7 +537,7 @@ def main(ctx, cfg) -> None:
                             else _sample_block(grad_steps)
                         )
                         for g in range(grad_steps):
-                            batch = {k: v[g] for k, v in sample.items()}
+                            batch = sample[g]
                             cumulative_grad_steps += 1
                             update_target = jnp.asarray(
                                 cumulative_grad_steps % target_update_freq == 0

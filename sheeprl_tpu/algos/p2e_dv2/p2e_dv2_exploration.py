@@ -528,7 +528,7 @@ def main(ctx, cfg) -> None:
                         else _sample_block(grad_steps)
                     )
                     for g in range(grad_steps):
-                        batch = {k: v[g] for k, v in sample.items()}
+                        batch = sample[g]
                         update_target = jnp.asarray(cumulative_grad_steps % target_update_freq == 0)
                         cumulative_grad_steps += 1
                         params, opt_states, train_metrics = train_jit(params, opt_states, batch, ctx.rng(), update_target)

@@ -281,7 +281,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
                     )
                     view = task_view(params)
                     for g in range(grad_steps):
-                        batch = {k: v[g] for k, v in sample.items()}
+                        batch = sample[g]
                         update_target = jnp.asarray(cumulative_grad_steps % target_update_freq == 0)
                         cumulative_grad_steps += 1
                         view, opt_states, moments_state, train_metrics = train_jit(

@@ -4,9 +4,11 @@ host-side replay sampling + transfer).
 
 ``AsyncBatchPrefetcher`` keeps ONE sample request in flight on a worker thread: while
 the accelerator executes the current block of gradient steps, the worker draws the next
-``[n_samples, T, B, ...]`` block from the replay buffer and ships it to the device
-(sharded, when a sharding is given).  ``get(n)`` returns the staged block when its shape
-matches and immediately queues the next one.
+``n`` gradient steps' worth of batches and ships them to the device.
+``make_replay_prefetcher``'s sampler produces a LIST of per-step ``[T, B, ...]`` batch
+dicts (each ``device_put`` separately, so step g executes while slice g+1 transfers);
+``get(n)`` returns the staged block when the staged COUNT covers ``n`` (slicing off the
+extra steps) and immediately queues the next request.
 
 Coherency: the worker samples under ``self.lock``; training loops must wrap their
 ``rb.add(...)`` calls with the same lock so the worker never reads a row mid-write.
@@ -58,9 +60,12 @@ class AsyncBatchPrefetcher:
             if staged_n > n:
                 # Oscillating Ratio (e.g. 1,2,1,2,...): reuse the staged block's
                 # first n samples instead of discarding the whole transfer.
-                import jax
+                if isinstance(block, list):
+                    block = block[:n]
+                else:
+                    import jax
 
-                block = jax.tree.map(lambda x: x[:n], block)
+                    block = jax.tree.map(lambda x: x[:n], block)
         else:
             if self._pending_n is not None:
                 self._res.get()  # drain the too-small in-flight block
@@ -86,24 +91,35 @@ class AsyncBatchPrefetcher:
 
 
 def make_replay_prefetcher(rb, ctx, cfg, batch_size: int, sequence_length: int):
-    """The training loops' standard setup: a sampler closure drawing
-    ``[n, T, B]`` blocks sharded over the ``data`` mesh axis, wrapped in a prefetcher
-    when ``algo.async_prefetch`` is on.  Returns ``(prefetcher_or_None, rb_lock,
-    sample_block)`` — loops must take ``rb_lock`` around every ``rb.add``."""
+    """The training loops' standard setup: a sampler closure drawing ``n`` gradient
+    steps' worth of ``[T, B]`` batches, wrapped in a prefetcher when
+    ``algo.async_prefetch`` is on.  Returns ``(prefetcher_or_None, rb_lock,
+    sample_block)`` — loops must take ``rb_lock`` around every ``rb.add``.
+
+    The block is shipped as a LIST of per-step batches, each ``device_put``
+    separately: the first gradient step can launch as soon as its own slice lands
+    instead of waiting for the whole ``[n, T, B]`` transfer (the async dispatch of
+    step g then overlaps the transfer of slice g+1)."""
     import contextlib
 
+    import jax
+    import numpy as np
+
+    sharding = (
+        ctx.batch_sharding(1)  # [T, B, ...] slices: batch axis 1 over the data mesh
+        if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
+        else None
+    )
+
     def sample_block(n: int):
-        return rb.sample_tensors(
-            batch_size,
-            sequence_length=sequence_length,
-            n_samples=n,
-            dtype=None,
-            sharding=(
-                ctx.batch_sharding(2)
-                if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
-                else None
-            ),
-        )
+        block = rb.sample(batch_size, sequence_length=sequence_length, n_samples=n)
+        out = []
+        for g in range(n):
+            step = {k: np.ascontiguousarray(v[g]) for k, v in block.items()}
+            out.append(
+                jax.device_put(step, sharding) if sharding is not None else jax.device_put(step)
+            )
+        return out
 
     if cfg.algo.get("async_prefetch", True):
         prefetcher = AsyncBatchPrefetcher(sample_block)

@@ -59,3 +59,18 @@ def test_prefetcher_lock_guards_buffer_writes():
         writes.append(1)
     assert pf.get(1) is not None
     pf.close()
+
+
+def test_prefetcher_list_block_slice_reuse():
+    """Per-step list blocks must be reused by LIST slicing, not leaf slicing."""
+    calls = []
+
+    def sample(n):
+        calls.append(n)
+        return [np.full((4, 2), g) for g in range(n)]
+
+    pf = AsyncBatchPrefetcher(sample)
+    pf.get(3)          # stages a 3-step block
+    block = pf.get(2)  # smaller request: first 2 staged steps, arrays intact
+    assert len(block) == 2 and block[0].shape == (4, 2)
+    pf.close()
