@@ -787,11 +787,13 @@ def build_agent(
         critic_params = {"params": zero_init_head(critic_params["params"], "head")}
 
     target_critic_params = jax.tree.map(lambda x: x, critic_params)
+    # shard_params == replicate on a model=1 mesh; with mesh.model>1 the large kernels
+    # are column-sharded over the model axis (tensor parallelism via GSPMD).
     params = {
-        "world_model": ctx.replicate(wm_params),
-        "actor": ctx.replicate(actor_params),
-        "critic": ctx.replicate(critic_params),
-        "target_critic": ctx.replicate(target_critic_params),
+        "world_model": ctx.shard_params(wm_params),
+        "actor": ctx.shard_params(actor_params),
+        "critic": ctx.shard_params(critic_params),
+        "target_critic": ctx.shard_params(target_critic_params),
     }
     return world_model, actor, critic, params, latent_size
 

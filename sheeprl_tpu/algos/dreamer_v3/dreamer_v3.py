@@ -338,7 +338,8 @@ def main(ctx, cfg) -> None:
     train_step, init_opt_states = make_train_step(
         world_model, actor, critic, cfg, cnn_keys, mlp_keys, {k: obs_space[k].shape for k in obs_keys}
     )
-    opt_states = ctx.replicate(init_opt_states(params))
+    # opt states mirror the params' (possibly tensor-parallel) placement
+    opt_states = ctx.shard_params(init_opt_states(params))
     moments_state = ctx.replicate(init_moments())
     train_jit = jax.jit(train_step, static_argnames=())
 
@@ -393,8 +394,8 @@ def main(ctx, cfg) -> None:
                 "moments": jax.device_get(moments_state),
             },
         )
-        params = ctx.replicate(state["params"])
-        opt_states = ctx.replicate(state["opt_states"])
+        params = ctx.shard_params(state["params"])
+        opt_states = ctx.shard_params(state["opt_states"])
         moments_state = ctx.replicate(state["moments"])
         ratio.load_state_dict(state["ratio"])
         start_iter = state["iter_num"] + 1

@@ -439,7 +439,7 @@ def main(ctx, cfg) -> None:
     train_step, init_opt_states, init_moments_state = make_train_step(
         world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp_keys, critic_cfgs
     )
-    opt_states = ctx.replicate(init_opt_states(params))
+    opt_states = ctx.shard_params(init_opt_states(params))
     moments_state = ctx.replicate(init_moments_state())
     train_jit = jax.jit(train_step)
 
@@ -498,8 +498,8 @@ def main(ctx, cfg) -> None:
                 "moments": jax.device_get(moments_state),
             },
         )
-        params = ctx.replicate(state["params"])
-        opt_states = ctx.replicate(state["opt_states"])
+        params = ctx.shard_params(state["params"])
+        opt_states = ctx.shard_params(state["opt_states"])
         moments_state = ctx.replicate(state["moments"])
         ratio.load_state_dict(state["ratio"])
         start_iter = state["iter_num"] + 1

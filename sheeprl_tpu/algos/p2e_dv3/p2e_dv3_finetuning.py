@@ -108,9 +108,9 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             "moments": jax.device_get(expl_moments_template),
         },
     )
-    params = ctx.replicate(state["params"])
+    params = ctx.shard_params(state["params"])
     loaded_opts = state["opt_states"]
-    opt_states = ctx.replicate(
+    opt_states = ctx.shard_params(
         {
             "world_model": loaded_opts["world_model"],
             "actor": loaded_opts["actor_task"],

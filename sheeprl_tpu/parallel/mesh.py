@@ -137,6 +137,33 @@ class MeshContext:
     def replicate(self, tree: Any) -> Any:
         return jax.device_put(tree, self.replicated)
 
+    @property
+    def model_parallel_size(self) -> int:
+        return self.mesh.shape["model"]
+
+    def shard_params(self, tree: Any, min_dim: int = 128) -> Any:
+        """Tensor-parallel parameter placement over the ``model`` mesh axis.
+
+        Every matrix leaf (``ndim >= 2``) whose output dimension divides the axis and
+        is at least ``min_dim`` gets its LAST dim sharded over ``model``; everything
+        else (biases, scales, small heads) is replicated.  GSPMD then propagates the
+        sharding through the jitted train step: matmuls against a column-sharded kernel
+        produce column-sharded activations, and the all-reduces land on ICI — no
+        per-layer annotations in the model code (SURVEY §2.4's "free with GSPMD").
+        With ``model=1`` (the default mesh) this is exactly ``replicate``.
+        """
+        mp = self.model_parallel_size
+        if mp <= 1:
+            return self.replicate(tree)
+
+        def _put(x):
+            if getattr(x, "ndim", 0) >= 2 and x.shape[-1] >= min_dim and x.shape[-1] % mp == 0:
+                spec = [None] * (x.ndim - 1) + ["model"]
+                return jax.device_put(x, self.sharding(*spec))
+            return jax.device_put(x, self.replicated)
+
+        return jax.tree.map(_put, tree)
+
     # -- rng ----------------------------------------------------------------
     def rng(self) -> jax.Array:
         """Split a fresh PRNG key off the context's chain (host-side bookkeeping)."""
@@ -161,7 +188,8 @@ class MeshContext:
 
     @contextlib.contextmanager
     def default_mesh(self):
-        with jax.sharding.use_mesh(self.mesh):
+        # Mesh is itself a context manager (the ambient mesh for shard_map/pjit).
+        with self.mesh:
             yield
 
 
