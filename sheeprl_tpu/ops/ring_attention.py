@@ -1,0 +1,115 @@
+"""Ring attention: sequence-parallel exact attention over the ``sequence`` mesh axis.
+
+The reference framework has no attention module and no context parallelism at all
+(SURVEY §2.4/§5: "no TP/PP/SP/EP/CP/ring-attention anywhere") — long-context support is
+a capability this framework adds natively.  The ``sequence`` axis reserved by
+``build_mesh`` becomes usable: queries stay put, key/value blocks rotate around the
+ring (``lax.ppermute`` over ICI neighbours), and a flash-style online-softmax
+accumulator keeps the result EXACT while each device only ever holds ``T/ring`` keys —
+memory per device is O(T·d/ring + T²/ring²) instead of O(T²).
+
+Shapes follow the usual convention: ``q, k, v: [B, T_local, H, D]`` sharded over the
+time axis (``PartitionSpec(None, "sequence")``).  ``ring_attention`` is the per-device
+function for use inside ``shard_map``; ``make_ring_attention`` wraps it with the
+``shard_map`` plumbing for a given mesh.  Causal masking uses global positions, so the
+semantics match full causal attention regardless of the ring size.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _attn_block(q, k_blk, v_blk, acc, m, l, scale, q_pos, kv_pos, causal):
+    """One flash-attention accumulation step against a single kv block.
+
+    ``acc``: [B, H, Tq, D] un-normalised output; ``m``: [B, H, Tq] running max;
+    ``l``: [B, H, Tq] running denominator."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale  # [B, H, Tq, Tk]
+    if causal:
+        mask = kv_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+    m_new = jnp.maximum(m, s.max(-1))
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        # re-mask: a fully-masked row has s == m_new == finfo.min everywhere, so the
+        # exp above would contribute p = 1 per masked entry without this zeroing
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(-1)
+    acc = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+    return acc, m_new, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sequence",
+    causal: bool = False,
+) -> jax.Array:
+    """Per-device ring attention body (call inside ``shard_map``).
+
+    ``q, k, v``: the LOCAL ``[B, T_local, H, D]`` blocks of a global ``[B, T, H, D]``
+    sequence sharded over ``axis_name``.  Returns the local ``[B, T_local, H, D]``
+    output of exact (optionally causal) attention over the full sequence.
+    """
+    ring = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, T_local, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))  # f32, matching the accumulators
+
+    q_pos = my_idx * T_local + jnp.arange(T_local)
+    acc = jnp.zeros((B, H, T_local, D), jnp.float32)
+    m = jnp.full((B, H, T_local), jnp.finfo(jnp.float32).min)
+    l = jnp.zeros((B, H, T_local))
+
+    qf, kf, vf = q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+    k_blk, v_blk = kf, vf
+    for r in range(ring):
+        src = (my_idx - r) % ring  # which device's kv block we currently hold
+        kv_pos = src * T_local + jnp.arange(T_local)
+        acc, m, l = _attn_block(qf, k_blk, v_blk, acc, m, l, scale, q_pos, kv_pos, causal)
+        if r + 1 < ring:
+            # rotate kv around the ring; overlaps with the next block's compute
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, Tq, D]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "sequence", causal: bool = False):
+    """Wrap ``ring_attention`` in ``shard_map`` for ``[B, T, H, D]`` inputs sharded
+    over ``axis_name`` on ``mesh`` (time axis 1)."""
+    spec = P(None, axis_name)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+
+    def apply(q, k, v):
+        sharding = NamedSharding(mesh, spec)
+        return fn(jax.device_put(q, sharding), jax.device_put(k, sharding), jax.device_put(v, sharding))
+
+    return apply
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False) -> jax.Array:
+    """Plain full-materialisation attention for parity checks."""
+    B, T, H, D = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, -1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
