@@ -88,22 +88,16 @@ def run_algorithm(cfg: DotDict) -> None:
 
 
 def eval_algorithm(cfg: DotDict) -> None:
-    """Evaluation dispatch (reference ``cli.py:202-268``)."""
+    """Evaluation dispatch (reference ``cli.py:202-268``).  ``cfg`` is the run's saved
+    config with the user's CLI overrides already merged on top
+    (``_load_checkpoint_cfg``), so structural keys (algorithm, model sizes, obs keys)
+    match the checkpoint unless the user explicitly overrides them.  Evaluation always
+    uses a single process with one environment."""
     from sheeprl_tpu.parallel.mesh import make_mesh_context
 
     ckpt_path = Path(cfg.checkpoint_path)
-    run_dir = ckpt_path.parent.parent if ckpt_path.is_dir() else ckpt_path.parent
-    old_cfg_path = run_dir / "config.yaml"
-    if not old_cfg_path.is_file():
-        old_cfg_path = ckpt_path.parent / "config.yaml"
-    if not old_cfg_path.is_file():
-        raise FileNotFoundError(f"No config.yaml found for checkpoint {ckpt_path}")
-    old_cfg = load_config(old_cfg_path)
-    # Evaluation runs the trained config with run-time knobs from the current one.
-    for key in ("env", "algo", "distribution", "exp_name", "seed", "log_root", "root_dir"):
-        if key in old_cfg:
-            cfg[key] = old_cfg[key]
-    cfg.env.capture_video = bool(cfg.get("capture_video", True))
+    if "capture_video" in cfg:  # top-level convenience alias for env.capture_video
+        cfg.env.capture_video = bool(cfg.capture_video)
     cfg.env.num_envs = 1
     cfg.run_name = cfg.get("run_name") or _default_run_name(cfg)
 
@@ -168,6 +162,11 @@ def evaluate(args: Optional[List[str]] = None) -> None:
     overrides = list(args if args is not None else sys.argv[1:])
     cfg, ckpt_path = _load_checkpoint_cfg(overrides, "checkpoint_path")
     cfg.checkpoint_path = str(ckpt_path)
+    # Eval records a video by default regardless of the training run's setting
+    # (reference cli.py:378); an explicit override still wins.
+    overridden = {ov.partition("=")[0].lstrip("+") for ov in overrides}
+    if not overridden & {"env.capture_video", "capture_video"}:
+        cfg.env.capture_video = True
     eval_algorithm(cfg)
 
 

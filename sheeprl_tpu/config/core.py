@@ -243,7 +243,51 @@ class Composer:
         self.source = source
         # CLI group selections beat every defaults-list entry, wherever it appears.
         self.group_overrides = dict(group_overrides or {})
+        # ``override group: option`` entries from enclosing files, in effect while
+        # their siblings (e.g. an inherited parent exp) are being processed.
+        self.scoped_overrides: Dict[str, Any] = {}
         self.applied_groups: set = set()
+        # group -> option actually loaded; a group is re-loaded only when the
+        # effective option differs (re-merging the same option after an exp's
+        # content would clobber the exp's value overrides with group defaults).
+        self.applied_options: Dict[str, str] = {}
+
+    def _effective_option(self, group: str, option: Any) -> Any:
+        if group in self.group_overrides:  # CLI wins over everything
+            return self.group_overrides[group]
+        return self.scoped_overrides.get(group, option)
+
+    def process_defaults(self, cfg: dict, defaults: List[Any], parent_group: str = "") -> None:
+        """Apply a ``defaults`` list with Hydra's ``override`` semantics: an
+        ``override group: option`` entry re-selects which option the group loads
+        *wherever* it is loaded (typically by an inherited parent exp) — it does NOT
+        re-merge the group file after the parent's content, which would clobber the
+        parent's value overrides with the group file's defaults."""
+        overrides_here: List[tuple] = []
+        plain: List[Any] = []
+        for entry in defaults:
+            if isinstance(entry, dict) and any(str(g).startswith("override") for g in entry):
+                for group, option in entry.items():
+                    group = str(group)[len("override") :].strip().lstrip("/")
+                    overrides_here.append((group, option))
+            else:
+                plain.append(entry)
+        pushed = []
+        for group, option in overrides_here:
+            # An enclosing (child) config's override beats this one, CLI beats both.
+            if group not in self.scoped_overrides:
+                self.scoped_overrides[group] = option
+                pushed.append(group)
+        try:
+            for entry in plain:
+                self._apply_default(cfg, entry, parent_group=parent_group)
+            # Override entries whose effective option no sibling loaded (directly or
+            # via this scope's redirection): load them here, in order.
+            for group, option in overrides_here:
+                self._select_and_load(cfg, group, option)
+        finally:
+            for group in pushed:
+                self.scoped_overrides.pop(group, None)
 
     def load_group_file(self, cfg: dict, group: str, option: str) -> None:
         rel = f"{group}/{option}" if group else option
@@ -259,8 +303,7 @@ class Composer:
         defaults = raw.pop("defaults", [])
         is_global = bool(raw.pop("_global_", False)) or group == "exp"
         # Process nested defaults first so the file's own content wins.
-        for entry in defaults:
-            self._apply_default(cfg, entry, parent_group=group)
+        self.process_defaults(cfg, defaults, parent_group=group)
         if is_global:
             _merge(cfg, raw)
         else:
@@ -275,7 +318,9 @@ class Composer:
         if entry == "_self_":
             return
         if isinstance(entry, str):
-            # "group/option" or bare "option" relative to the parent group
+            # "group/option" or bare "option" relative to the parent group.  Bare
+            # within-group inheritance (e.g. algo/dreamer_v3_S ← dreamer_v3) is NOT
+            # subject to scoped overrides — redirecting it would self-recurse.
             if "/" in entry:
                 group, option = entry.rsplit("/", 1)
             else:
@@ -284,22 +329,26 @@ class Composer:
             return
         if isinstance(entry, dict):
             for group, option in entry.items():
-                group = str(group)
-                if group.startswith("override"):
-                    group = group[len("override") :]
-                group = group.strip().lstrip("/")
-                if group in self.group_overrides:
-                    option = self.group_overrides[group]
-                if option is None or option == "null":
-                    continue
-                if str(option).startswith("???"):
-                    # Mandatory group: must be chosen by an override; record it.
-                    cfg.setdefault("_mandatory_groups_", []).append(group)
-                    continue
-                self.applied_groups.add(group)
-                self.load_group_file(cfg, group, str(option))
+                self._select_and_load(cfg, str(group).strip().lstrip("/"), option)
             return
         raise ValueError(f"Unsupported defaults entry: {entry!r}")
+
+    def _select_and_load(self, cfg: dict, group: str, option: Any) -> None:
+        """Resolve a group selection (CLI > enclosing overrides > the entry itself)
+        and load it, unless that exact option was already loaded or the selection is
+        null/mandatory."""
+        option = self._effective_option(group, option)
+        if option is None or option == "null":
+            return
+        if str(option).startswith("???"):
+            # Mandatory group: must be chosen by an override; record it.
+            cfg.setdefault("_mandatory_groups_", []).append(group)
+            return
+        if self.applied_options.get(group) == str(option):
+            return
+        self.applied_groups.add(group)
+        self.applied_options[group] = str(option)
+        self.load_group_file(cfg, group, str(option))
 
 
 def compose(
@@ -348,12 +397,13 @@ def compose(
     # Apply defaults; CLI group selections substitute in wherever the group appears
     # (root defaults or nested exp defaults).
     composer = Composer(source, group_overrides)
-    for entry in defaults:
-        if entry == "_self_":
-            _merge(cfg, raw)
-            continue
-        composer._apply_default(cfg, entry)
-    if "_self_" not in defaults:
+    if "_self_" in defaults:
+        self_pos = defaults.index("_self_")
+        composer.process_defaults(cfg, defaults[:self_pos])
+        _merge(cfg, raw)
+        composer.process_defaults(cfg, defaults[self_pos + 1 :])
+    else:
+        composer.process_defaults(cfg, defaults)
         _merge(cfg, raw)
 
     # Group overrides never consumed by any defaults list (e.g. exp=...).

@@ -235,9 +235,12 @@ class ActionsAsObservationWrapper(gym.Wrapper):
                 self._noop[offset + int(a)] = 1.0
                 offset += int(n)
         elif isinstance(act_space, gym.spaces.Box):
-            if not isinstance(noop, (list, tuple)):
-                raise ValueError(f"The noop actions must be a list for continuous action spaces, got: {noop}")
             self._per_action = int(np.prod(act_space.shape))
+            if isinstance(noop, (int, float)):
+                # scalar noop broadcasts over the action vector (reference accepts a float)
+                noop = [float(noop)] * self._per_action
+            if not isinstance(noop, (list, tuple)):
+                raise ValueError(f"The noop action must be a float or list for continuous action spaces, got: {noop}")
             if len(noop) != self._per_action:
                 raise ValueError(f"The noop action must be a list of length {self._per_action}, got: {len(noop)}")
             self._noop = np.asarray(noop, dtype=np.float32)

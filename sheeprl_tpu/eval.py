@@ -1,0 +1,12 @@
+"""Evaluation launcher (reference ``sheeprl_eval.py`` / console script ``sheeprl-eval``):
+
+    python -m sheeprl_tpu.eval checkpoint_path=<run>/checkpoints/ckpt_N [overrides]
+
+Loads the run's saved config, merges the overrides, and dispatches to the algorithm's
+registered evaluation entry point (reference ``cli.py:202,369``).
+"""
+
+from sheeprl_tpu.cli import evaluate
+
+if __name__ == "__main__":
+    evaluate()

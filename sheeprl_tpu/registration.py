@@ -1,0 +1,14 @@
+"""Model-registration launcher (reference ``sheeprl_model_manager.py`` / console
+script ``sheeprl-registration``, ``cli.py:408``):
+
+    python -m sheeprl_tpu.registration checkpoint_path=<run>/checkpoints/ckpt_N \
+        [model_manager.name=...] [overrides]
+
+Registers a training checkpoint's models in the configured registry (local
+filesystem by default, MLflow when ``model_manager.backend=mlflow``).
+"""
+
+from sheeprl_tpu.cli import registration
+
+if __name__ == "__main__":
+    registration()

@@ -55,9 +55,15 @@ def test_actions_as_observation_discrete():
     assert obs["action_stack"][-1] == 1.0  # last action one-hot at idx 2
 
 
-def test_actions_as_observation_continuous_noop_validation():
+def test_actions_as_observation_continuous_noop():
+    # scalar float noop broadcasts over the action vector (reference accepts a float)
+    env = ActionsAsObservationWrapper(ContinuousDummyEnv(action_dim=2), num_stack=2, noop=0.0)
+    obs, _ = env.reset()
+    assert obs["action_stack"].shape == (4,)
+    assert (obs["action_stack"] == 0.0).all()
+    # a wrong-length list is still rejected
     with pytest.raises(ValueError):
-        ActionsAsObservationWrapper(ContinuousDummyEnv(action_dim=2), num_stack=2, noop=0)
+        ActionsAsObservationWrapper(ContinuousDummyEnv(action_dim=2), num_stack=2, noop=[0.0, 0.0, 0.0])
 
 
 def test_reward_as_observation():

@@ -57,3 +57,38 @@ def test_dotdict_attribute_access():
     assert d.a.b == 1
     d.a.c = 2
     assert d["a"]["c"] == 2
+
+
+def test_every_exp_preset_composes():
+    """Every shipped exp preset must compose without errors (the reference's whole
+    config tree is usable out of the box; a broken preset is a silent capability gap).
+    Finetuning presets require the exploration checkpoint path, like the reference."""
+    import pathlib
+
+    import sheeprl_tpu.config.core as core
+
+    exp_dir = pathlib.Path(core.__file__).parent / "configs" / "exp"
+    names = sorted(p.stem for p in exp_dir.glob("*.yaml"))
+    assert len(names) >= 49
+    for name in names:
+        overrides = [f"exp={name}"]
+        if "finetuning" in name or "fntn" in name:
+            overrides.append("checkpoint.exploration_ckpt_path=/tmp/ckpt")
+        cfg = compose(overrides=overrides)
+        assert cfg.algo.name, name
+
+
+def test_exp_inheriting_exp_keeps_concrete_values():
+    """``override /algo:`` in a child exp re-selects the option the parent exp's
+    defaults load — it must NOT re-merge the algo group file after the parent exp's
+    content, which would clobber the parent's concrete values (batch size, obs keys)
+    with the group file's defaults (Hydra defaults-list semantics)."""
+    cfg = compose(overrides=["exp=dreamer_v3_100k_ms_pacman"])
+    assert cfg.algo.per_rank_batch_size == 16  # from exp dreamer_v3
+    assert cfg.algo.cnn_keys.encoder == ["rgb"]  # from exp dreamer_v3
+    assert cfg.algo.world_model.recurrent_model.recurrent_state_size == 512  # S size
+    assert cfg.algo.replay_ratio == 1  # reference exp/dreamer_v3.yaml:11
+    # CLI group selections still beat the child exp's override entries.
+    cfg = compose(overrides=["exp=dreamer_v3_100k_ms_pacman", "algo=dreamer_v3_M"])
+    assert cfg.algo.world_model.recurrent_model.recurrent_state_size == 1024  # M size
+    assert cfg.algo.per_rank_batch_size == 16
