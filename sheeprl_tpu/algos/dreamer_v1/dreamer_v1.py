@@ -95,7 +95,7 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys):
 
             keys = jax.random.split(k_wm, T)
             init = (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size)))
-            _, (recs, posts, post_ms, prior_ms) = jax.lax.scan(step, init, (batch_actions, embed, keys))
+            _, (recs, posts, post_ms, prior_ms) = jax.lax.scan(step, init, (batch_actions, embed, keys), unroll=8)
             latents = jnp.concatenate([posts, recs], -1)
             recon = world_model.apply(wm_params, latents, method=WorldModelV1.decode)
 
@@ -152,7 +152,7 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys):
                 return (prior, rec, new_latent), new_latent
 
             keys = jax.random.split(k_img, horizon)
-            _, traj = jax.lax.scan(img_step, (prior0, rec0, latent0), keys)  # [H, N, L] (no initial latent)
+            _, traj = jax.lax.scan(img_step, (prior0, rec0, latent0), keys, unroll=5)  # [H, N, L] (no initial latent)
 
             values = critic.apply(params["critic"], traj)
             rewards_img = world_model.apply(new_wm_params, traj, method=WorldModelV1.reward)

@@ -115,7 +115,7 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys):
             keys = jax.random.split(k_wm, T)
             init = (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size)))
             _, (recs, posts, post_logits, prior_logits) = jax.lax.scan(
-                step, init, (batch_actions, embed, is_first, keys)
+                step, init, (batch_actions, embed, is_first, keys), unroll=8
             )
             latents = jnp.concatenate([posts, recs], -1)
             recon = world_model.apply(wm_params, latents, method=WorldModelV2.decode)
@@ -179,7 +179,7 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys):
                 return (prior, rec, new_latent), (new_latent, action)
 
             keys = jax.random.split(k_img, horizon)
-            _, (latents_img, actions_img) = jax.lax.scan(img_step, (prior0, rec0, latent0), keys)
+            _, (latents_img, actions_img) = jax.lax.scan(img_step, (prior0, rec0, latent0), keys, unroll=5)
             traj = jnp.concatenate([latent0[None], latents_img], 0)  # [H+1, N, L]
             imagined_actions = jnp.concatenate(
                 [jnp.zeros_like(actions_img[:1]), actions_img], 0

@@ -124,8 +124,10 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys, obs_sha
                     return rec, (rec, prior_logits)
 
                 keys = jax.random.split(k_scan, T)
+                # unroll: the per-step GRU work is tiny at batch B, so amortising the
+                # loop structure over several steps keeps the MXU fed
                 _, (recs, prior_logits) = jax.lax.scan(
-                    step, jnp.zeros((B, rec_size)), (prev_posts, batch_actions, is_first, keys)
+                    step, jnp.zeros((B, rec_size)), (prev_posts, batch_actions, is_first, keys), unroll=8
                 )
             else:
 
@@ -139,8 +141,10 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys, obs_sha
 
                 keys = jax.random.split(k_wm, T)
                 init = (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size)))
+                # unroll: the per-step GRU work is tiny at batch B, so amortising the
+                # loop structure over several steps keeps the MXU fed
                 _, (recs, posts, post_logits, prior_logits) = jax.lax.scan(
-                    step, init, (batch_actions, embed, is_first, keys)
+                    step, init, (batch_actions, embed, is_first, keys), unroll=8
                 )
             latents = jnp.concatenate([posts, recs], -1)  # [T,B,L]
             recon = world_model.apply(wm_params, latents, method=WorldModel.decode)
@@ -210,7 +214,7 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys, obs_sha
                 return (prior, rec, action), (latent, action)
 
             keys = jax.random.split(k_img, horizon)
-            _, (latents_img, actions_img) = jax.lax.scan(img_step, (prior0, rec0, a0), keys)
+            _, (latents_img, actions_img) = jax.lax.scan(img_step, (prior0, rec0, a0), keys, unroll=5)
             traj = jnp.concatenate([latent0[None], latents_img], 0)  # [H+1, TB, L]
             imagined_actions = jnp.concatenate([a0[None], actions_img], 0)  # [H+1, TB, A]
 
@@ -231,7 +235,9 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys, obs_sha
                 carry = it + ct * gamma * lmbda * carry
                 return carry, carry
 
-            _, lambda_values = jax.lax.scan(lam_step, values[-1], (interm, continues[1:]), reverse=True)
+            _, lambda_values = jax.lax.scan(
+                lam_step, values[-1], (interm, continues[1:]), reverse=True, unroll=8
+            )
 
             discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, 0) / gamma)
 

@@ -92,7 +92,7 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
             return (prior, rec, new_latent), (new_latent, action)
 
         keys = jax.random.split(k_img, horizon)
-        _, (traj, actions) = jax.lax.scan(img_step, (prior0, rec0, latent0), keys)
+        _, (traj, actions) = jax.lax.scan(img_step, (prior0, rec0, latent0), keys, unroll=5)
         return traj, actions  # both [H, N, ...]
 
     def _continues(wm_params, traj, like):
@@ -126,7 +126,7 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
 
             keys = jax.random.split(k_wm, T)
             init = (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size)))
-            _, (recs, posts, post_ms, prior_ms) = jax.lax.scan(step, init, (batch_actions, embed, keys))
+            _, (recs, posts, post_ms, prior_ms) = jax.lax.scan(step, init, (batch_actions, embed, keys), unroll=8)
             latents = jnp.concatenate([posts, recs], -1)
             recon = world_model.apply(wm_params, latents, method=WorldModelV1.decode)
 

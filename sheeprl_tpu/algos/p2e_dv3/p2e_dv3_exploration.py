@@ -120,7 +120,7 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
             carry = it + ct * gamma * lmbda * carry
             return carry, carry
 
-        _, lv = jax.lax.scan(lam_step, values[-1], (interm, continues[1:]), reverse=True)
+        _, lv = jax.lax.scan(lam_step, values[-1], (interm, continues[1:]), reverse=True, unroll=8)
         return lv
 
     def _imagine(actor_params, wm_params, prior0, rec0, latent0, k_img, k_a0):
@@ -138,7 +138,7 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
             return (prior, rec, action), (latent, action)
 
         keys = jax.random.split(k_img, horizon)
-        _, (latents_img, actions_img) = jax.lax.scan(img_step, (prior0, rec0, a0), keys)
+        _, (latents_img, actions_img) = jax.lax.scan(img_step, (prior0, rec0, a0), keys, unroll=5)
         traj = jnp.concatenate([latent0[None], latents_img], 0)
         imagined_actions = jnp.concatenate([a0[None], actions_img], 0)
         return traj, imagined_actions
@@ -189,7 +189,7 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
             keys = jax.random.split(k_wm, T)
             init = (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size)))
             _, (recs, posts, post_logits, prior_logits) = jax.lax.scan(
-                step, init, (batch_actions, embed, is_first, keys)
+                step, init, (batch_actions, embed, is_first, keys), unroll=8
             )
             latents = jnp.concatenate([posts, recs], -1)
             recon = world_model.apply(wm_params, latents, method=WorldModel.decode)

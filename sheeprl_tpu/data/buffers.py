@@ -190,18 +190,26 @@ class ReplayBuffer:
         self, idxes: np.ndarray, batch_size: int, n_samples: int, sample_next_obs: bool, clone: bool
     ) -> Dict[str, np.ndarray]:
         env_idxes = self._rng.integers(0, self._n_envs, size=idxes.shape[0])
+        rows64 = idxes.astype(np.int64)
+        env64 = env_idxes.astype(np.int64)
         out: Dict[str, np.ndarray] = {}
+        from sheeprl_tpu import native
+
         for k, v in self._buf.items():
             arr = _np(v)
-            picked = arr[idxes, env_idxes]
-            out[k] = picked.reshape(n_samples, batch_size, *arr.shape[2:])
-            if clone:
-                out[k] = out[k].copy()
-            if sample_next_obs and k in self._obs_keys:
-                nxt = arr[(idxes + 1) % self._buffer_size, env_idxes]
-                out[f"next_{k}"] = nxt.reshape(n_samples, batch_size, *arr.shape[2:])
+            picked = native.gather_rows(arr, rows64, env64)  # GIL-releasing C gather
+            if picked is None:
+                picked = arr[idxes, env_idxes]
                 if clone:
-                    out[f"next_{k}"] = out[f"next_{k}"].copy()
+                    picked = picked.copy()
+            out[k] = picked.reshape(n_samples, batch_size, *arr.shape[2:])
+            if sample_next_obs and k in self._obs_keys:
+                nxt = native.gather_rows(arr, (rows64 + 1) % self._buffer_size, env64)
+                if nxt is None:
+                    nxt = arr[(idxes + 1) % self._buffer_size, env_idxes]
+                    if clone:
+                        nxt = nxt.copy()
+                out[f"next_{k}"] = nxt.reshape(n_samples, batch_size, *arr.shape[2:])
         return out
 
     def sample_tensors(
@@ -309,21 +317,41 @@ class SequentialReplayBuffer(ReplayBuffer):
         batch_dim = batch_size * n_samples
         # One environment per sequence.
         env_idxes = self._rng.integers(0, self._n_envs, size=batch_dim)
-        env_idxes_tiled = np.repeat(env_idxes[:, None], sequence_length, axis=1)
+        starts = idxes[:, 0].astype(np.int64)  # idxes rows are (start + t) % size
+        env64 = env_idxes.astype(np.int64)
+        env_idxes_tiled = None
         out: Dict[str, np.ndarray] = {}
+        from sheeprl_tpu import native
+
         for k, v in self._buf.items():
             arr = _np(v)
-            picked = arr[idxes.ravel(), env_idxes_tiled.ravel()]
-            picked = picked.reshape(n_samples, batch_size, sequence_length, *arr.shape[2:])
-            out[k] = np.swapaxes(picked, 1, 2)  # [n_samples, T, B, ...]
-            if clone:
-                out[k] = out[k].copy()
-            if sample_next_obs and k in self._obs_keys:
-                nxt = arr[(idxes.ravel() + 1) % self._buffer_size, env_idxes_tiled.ravel()]
-                nxt = nxt.reshape(n_samples, batch_size, sequence_length, *arr.shape[2:])
-                out[f"next_{k}"] = np.swapaxes(nxt, 1, 2)
+            # Native one-pass gather straight into the time-major [N, T, B, ...]
+            # layout (no transpose copy, GIL released); numpy fallback below.
+            picked = native.gather_seq(arr, starts, env64, n_samples, sequence_length, batch_size)
+            if picked is not None:
+                out[k] = picked
+            else:
+                if env_idxes_tiled is None:
+                    env_idxes_tiled = np.repeat(env_idxes[:, None], sequence_length, axis=1)
+                picked = arr[idxes.ravel(), env_idxes_tiled.ravel()]
+                picked = picked.reshape(n_samples, batch_size, sequence_length, *arr.shape[2:])
+                out[k] = np.swapaxes(picked, 1, 2)  # [n_samples, T, B, ...]
                 if clone:
-                    out[f"next_{k}"] = out[f"next_{k}"].copy()
+                    out[k] = out[k].copy()
+            if sample_next_obs and k in self._obs_keys:
+                nxt = native.gather_seq(
+                    arr, starts, env64, n_samples, sequence_length, batch_size, start_offset=1
+                )
+                if nxt is not None:
+                    out[f"next_{k}"] = nxt
+                else:
+                    if env_idxes_tiled is None:
+                        env_idxes_tiled = np.repeat(env_idxes[:, None], sequence_length, axis=1)
+                    nxt = arr[(idxes.ravel() + 1) % self._buffer_size, env_idxes_tiled.ravel()]
+                    nxt = nxt.reshape(n_samples, batch_size, sequence_length, *arr.shape[2:])
+                    out[f"next_{k}"] = np.swapaxes(nxt, 1, 2)
+                    if clone:
+                        out[f"next_{k}"] = out[f"next_{k}"].copy()
         return out
 
 

@@ -1,0 +1,124 @@
+"""Native (C++) runtime components.
+
+The compute path is XLA/Pallas; this package holds the host-side native pieces —
+currently the replay-sequence gather that feeds the device (``gather.cpp``).  The
+shared library is compiled once on first use with the image's g++ and cached next
+to the source; every consumer falls back to the numpy path if the toolchain or the
+cached library is unavailable, so the framework never hard-depends on it.
+Disable explicitly with ``SHEEPRL_TPU_NATIVE=0``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / "gather.cpp"
+_LIB = _HERE / "_gather.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    # Per-process tmp name: concurrent first-use builds (e.g. a multi-host launch on a
+    # fresh checkout) must not write into each other's output; os.replace is atomic.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The gather library, building it on first call; None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SHEEPRL_TPU_NATIVE", "1") == "0":
+            return None
+        if not _LIB.is_file() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+            lib.gather_seq.restype = None
+            lib.gather_seq.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, _I64P, _I64P,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ]
+            lib.gather_rows.restype = None
+            lib.gather_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, _I64P, _I64P,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def gather_seq(
+    src: np.ndarray,
+    starts: np.ndarray,
+    env_idx: np.ndarray,
+    n_samples: int,
+    seq_len: int,
+    batch: int,
+    start_offset: int = 0,
+) -> Optional[np.ndarray]:
+    """Gather ``[n_samples, T, B, *feat]`` sequences from a ``[size, n_envs, *feat]``
+    C-contiguous buffer in one pass (time-major output, no transpose copy).
+    ``starts``/``env_idx`` are ``[n_samples*B]`` int64, sample-major.  Returns None
+    when the native path can't serve this array (not contiguous / lib missing)."""
+    lib = load()
+    if lib is None or not src.flags["C_CONTIGUOUS"] or src.size == 0:
+        return None
+    feat_bytes = int(src.itemsize * np.prod(src.shape[2:], dtype=np.int64))
+    out = np.empty((n_samples, seq_len, batch) + src.shape[2:], dtype=src.dtype)
+    lib.gather_seq(
+        src.ctypes.data, out.ctypes.data,
+        np.ascontiguousarray(starts, dtype=np.int64),
+        np.ascontiguousarray(env_idx, dtype=np.int64),
+        n_samples, seq_len, batch, src.shape[0], src.shape[1], feat_bytes,
+        start_offset,
+    )
+    return out
+
+
+def gather_rows(src: np.ndarray, rows: np.ndarray, envs: np.ndarray) -> Optional[np.ndarray]:
+    """dst[i] = src[rows[i], envs[i]] for a ``[size, n_envs, *feat]`` buffer."""
+    lib = load()
+    if lib is None or not src.flags["C_CONTIGUOUS"] or src.size == 0:
+        return None
+    n = int(rows.shape[0])
+    feat_bytes = int(src.itemsize * np.prod(src.shape[2:], dtype=np.int64))
+    out = np.empty((n,) + src.shape[2:], dtype=src.dtype)
+    lib.gather_rows(
+        src.ctypes.data, out.ctypes.data,
+        np.ascontiguousarray(rows, dtype=np.int64),
+        np.ascontiguousarray(envs, dtype=np.int64),
+        n, src.shape[1], feat_bytes,
+    )
+    return out

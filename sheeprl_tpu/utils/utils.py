@@ -68,6 +68,7 @@ def gae(
         (rewards, values, next_values, not_done),
         length=num_steps,
         reverse=True,
+        unroll=8,
     )
     returns = advs + values
     return returns, advs
@@ -96,6 +97,7 @@ def lambda_returns(
         values[-1],
         (interm[:-1], continues[:-1]),
         reverse=True,
+        unroll=8,
     )
     return rets
 
