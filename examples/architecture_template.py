@@ -1,0 +1,86 @@
+"""Decoupled player/learner architecture template (reference
+``examples/architecture_template.py``, which builds a 3-role torch-collective
+pipeline; see SURVEY.md §3.3).
+
+The TPU-native decoupling is thread + queue based inside the single-controller
+process instead of one torch process per role: the PLAYER steps the envs on the
+host and feeds rollouts through a bounded queue; the LEARNER runs the jitted
+update on the device mesh and publishes fresh params back through a second queue.
+Use this skeleton to build your own decoupled algorithm — the shipped
+``ppo_decoupled`` / ``sac_decoupled`` entries follow exactly this structure
+(``sheeprl_tpu/algos/ppo/ppo_decoupled.py``).
+
+Run:  python examples/architecture_template.py
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def learner(rollout_q: queue.Queue, param_q: queue.Queue, stop: threading.Event) -> None:
+    """Consume rollouts, run the jitted update, publish params."""
+
+    @jax.jit
+    def update(params, batch):
+        # your loss/grad/optimizer step here
+        return jax.tree.map(lambda p: p + 0.01 * batch["reward"].mean(), params)
+
+    params = {"w": jnp.zeros(())}
+    while not stop.is_set():
+        try:
+            batch = rollout_q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        if batch is None:  # player finished
+            break
+        params = update(params, batch)
+        # Publish without blocking the training loop: replace the stale snapshot if
+        # the player has not picked it up yet.
+        snapshot = jax.device_get(params)
+        try:
+            param_q.put_nowait(snapshot)
+        except queue.Full:
+            try:
+                param_q.get_nowait()  # evict the stale snapshot …
+            except queue.Empty:
+                pass
+            try:
+                param_q.put_nowait(snapshot)  # … and publish the fresh one
+            except queue.Full:
+                pass
+
+
+def player(rollout_q: queue.Queue, param_q: queue.Queue, total_steps: int) -> None:
+    """Step the env with the freshest published params, enqueue rollouts."""
+    params = {"w": np.zeros(())}
+    rng = np.random.default_rng(0)
+    for _ in range(total_steps):
+        try:
+            params = param_q.get_nowait()  # refresh when the learner published
+        except queue.Empty:
+            pass
+        rollout = {"obs": rng.normal(size=(8, 4)), "reward": rng.normal(size=(8,))}
+        rollout_q.put(rollout)  # bounded: applies backpressure if the learner lags
+    rollout_q.put(None)
+
+
+def main() -> None:
+    rollout_q: queue.Queue = queue.Queue(maxsize=2)
+    param_q: queue.Queue = queue.Queue(maxsize=1)
+    stop = threading.Event()
+    t = threading.Thread(target=learner, args=(rollout_q, param_q, stop), daemon=True)
+    t.start()
+    player(rollout_q, param_q, total_steps=32)
+    t.join(timeout=30)
+    stop.set()
+    print("decoupled template finished")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Print the observation/action space an env config produces after the full
+``make_env`` wrapper pipeline (reference ``examples/observation_space.py``).
+
+    python examples/observation_space.py env=gym env.id=CartPole-v1
+    python examples/observation_space.py env=discrete_dummy algo=dreamer_v3
+"""
+
+from __future__ import annotations
+
+import sys
+
+from sheeprl_tpu.config.core import compose
+from sheeprl_tpu.utils.env import make_env
+
+
+def main() -> None:
+    overrides = sys.argv[1:] or ["env=discrete_dummy"]
+    if not any(o.startswith(("exp=", "algo=")) for o in overrides):
+        overrides.append("algo=ppo")  # any algo satisfies the mandatory group
+    # only the env subtree matters here; satisfy the other required values
+    overrides = ["algo.total_steps=1", "algo.per_rank_batch_size=1", "buffer.size=1", *overrides]
+    cfg = compose(overrides=overrides)
+    if not (cfg.algo.cnn_keys.encoder or cfg.algo.mlp_keys.encoder):
+        cfg.algo.mlp_keys.encoder = ["state"]
+        cfg.algo.cnn_keys.encoder = ["rgb"]
+    env = make_env(cfg, seed=cfg.seed, rank=0)()
+    try:
+        print(f"env.id          = {cfg.env.id}")
+        print(f"observation space:")
+        for name, space in env.observation_space.spaces.items():
+            print(f"  {name:20s} {space}")
+        print(f"action space    = {env.action_space}")
+    finally:
+        env.close()
+
+
+if __name__ == "__main__":
+    main()

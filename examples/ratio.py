@@ -1,0 +1,21 @@
+"""Demonstrate the replay-ratio governor (reference ``examples/ratio.py``):
+``Ratio(r)`` is called with the cumulative policy-step count each iteration and
+returns how many gradient steps to run so the long-run gradient-steps /
+policy-steps ratio converges to ``r`` — including fractional ratios, where whole
+gradient steps are emitted only when enough policy steps have accumulated.
+
+    python examples/ratio.py
+"""
+
+from sheeprl_tpu.utils.utils import Ratio
+
+if __name__ == "__main__":
+    for r in (1.0, 0.5, 0.0625):
+        ratio = Ratio(r)
+        policy_step, grad_steps = 0, 0
+        per_iter = 4  # e.g. 4 envs x 1 step
+        for _ in range(64):
+            policy_step += per_iter
+            grad_steps += ratio(policy_step)
+        print(f"target ratio {r:<8} achieved {grad_steps / policy_step:.4f} "
+              f"({grad_steps} gradient steps over {policy_step} policy steps)")

@@ -1,0 +1,10 @@
+"""sheeprl-tpu: a TPU-native deep reinforcement learning framework.
+
+The capability surface of SheepRL (reference layout ``sheeprl/__init__.py``) —
+14 algorithm entry points, a Hydra-style config CLI, replay buffers, gymnasium env
+pipelines, checkpoint/resume, metrics, eval, model registry — rebuilt from scratch
+on JAX/XLA: jitted ``lax.scan`` training steps, GSPMD data/tensor/sequence
+parallelism over a device mesh, Pallas kernels and a native C++ host data path.
+"""
+
+__version__ = "0.2.0"

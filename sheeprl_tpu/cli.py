@@ -62,6 +62,30 @@ def check_configs(cfg: DotDict) -> None:
         raise ValueError("algo.cnn_keys.encoder and algo.mlp_keys.encoder must be lists")
     if cfg.metric.get("log_level", 1) not in (0, 1):
         raise ValueError(f"Invalid metric.log_level: {cfg.metric.log_level}")
+    # Sequence-sampling algorithms: the prefill must leave every env's sub-buffer with
+    # at least one full sequence, or the first train iteration dies mid-run with a
+    # sampling error.  Prefill iterations (= rows per env) are
+    # learning_starts // (num_envs * world * action_repeat) — the loops' own divisor.
+    # World size comes from the config, NOT jax.process_count(): touching jax here
+    # would initialize the backend before jax.distributed.initialize() runs.
+    seq_len = int(algo.get("per_rank_sequence_length", 0) or 0)
+    learning_starts = int(algo.get("learning_starts", 0) or 0)
+    buffer_prefilled = bool(cfg.checkpoint.get("resume_from")) or bool(
+        cfg.get("buffer", {}).get("load_from_exploration", False)
+    )
+    if seq_len > 1 and learning_starts > 0 and not buffer_prefilled and not cfg.get("dry_run", False):
+        world = int(cfg.get("mesh", {}).get("distributed", {}).get("num_processes") or 1)
+        steps_per_iter = max(cfg.env.num_envs * world * max(cfg.env.action_repeat, 1), 1)
+        rows_per_env = learning_starts // steps_per_iter
+        if rows_per_env < seq_len:
+            raise ValueError(
+                f"algo.learning_starts={learning_starts} prefills only ~{rows_per_env} steps per "
+                f"environment ({cfg.env.num_envs} envs x {world} process(es) x action_repeat "
+                f"{cfg.env.action_repeat}), but algo.per_rank_sequence_length={seq_len} needs at "
+                f"least {seq_len} steps per env before the first gradient step. Raise "
+                f"learning_starts to >= {seq_len * steps_per_iter} or lower the sequence "
+                f"length / env count."
+            )
 
 
 def run_algorithm(cfg: DotDict) -> None:
@@ -194,3 +218,28 @@ def registration(args: Optional[List[str]] = None) -> None:
 def available_algorithms() -> List[str]:
     _import_algorithms()
     return sorted(algorithm_registry)
+
+
+def agents(args: Optional[List[str]] = None) -> None:
+    """List registered agents (reference ``sheeprl-agents`` /
+    ``available_agents.py``): one row per entry point, with its module, whether it
+    runs decoupled, and whether an evaluation entry is registered."""
+    _import_algorithms()
+    rows = []
+    for name in sorted(algorithm_registry):
+        entry = algorithm_registry[name]
+        rows.append(
+            (
+                name,
+                entry["module"],
+                "yes" if entry.get("decoupled") else "no",
+                "yes" if name in evaluation_registry else "no",
+            )
+        )
+    headers = ("algorithm", "module", "decoupled", "evaluable")
+    widths = [max(len(h), *(len(r[i]) for r in rows)) for i, h in enumerate(headers)]
+    line = "  ".join(h.ljust(w) for h, w in zip(headers, widths))
+    print(line)
+    print("-" * len(line))
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))

@@ -373,3 +373,25 @@ def test_dreamer_v3_decoupled_rssm(tmp_path):
         + ["env=discrete_dummy", "algo.world_model.decoupled_rssm=True"]
         + standard_args(tmp_path, extra=["dry_run=False"])
     )
+
+
+def test_every_algorithm_has_evaluation():
+    """Every registered entry point must have an evaluation entry, or
+    ``sheeprl_tpu.eval`` dies at dispatch for that algorithm (reference registers an
+    evaluate function per algo in ``sheeprl/__init__.py:18-47``)."""
+    from sheeprl_tpu.cli import _import_algorithms
+    from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
+
+    _import_algorithms()
+    assert len(algorithm_registry) >= 17
+    missing = set(algorithm_registry) - set(evaluation_registry)
+    assert not missing, f"algorithms without a registered evaluation: {sorted(missing)}"
+
+
+def test_agents_listing(capsys):
+    from sheeprl_tpu.cli import agents
+
+    agents()
+    out = capsys.readouterr().out
+    assert "dreamer_v3" in out and "sac_decoupled" in out
+    assert "decoupled" in out.splitlines()[0]

@@ -67,6 +67,9 @@ def test_every_exp_preset_composes():
 
     import sheeprl_tpu.config.core as core
 
+    from sheeprl_tpu.cli import _import_algorithms, check_configs
+
+    _import_algorithms()
     exp_dir = pathlib.Path(core.__file__).parent / "configs" / "exp"
     names = sorted(p.stem for p in exp_dir.glob("*.yaml"))
     assert len(names) >= 49
@@ -76,6 +79,7 @@ def test_every_exp_preset_composes():
             overrides.append("checkpoint.exploration_ckpt_path=/tmp/ckpt")
         cfg = compose(overrides=overrides)
         assert cfg.algo.name, name
+        check_configs(cfg)  # incl. the prefill-vs-sequence-length guard
 
 
 def test_exp_inheriting_exp_keeps_concrete_values():
