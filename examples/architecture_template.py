@@ -56,7 +56,9 @@ def learner(rollout_q: queue.Queue, param_q: queue.Queue, stop: threading.Event)
                 pass
 
 
-def player(rollout_q: queue.Queue, param_q: queue.Queue, total_steps: int) -> None:
+def player(
+    rollout_q: queue.Queue, param_q: queue.Queue, total_steps: int, learner_thread: threading.Thread
+) -> None:
     """Step the env with the freshest published params, enqueue rollouts."""
     params = {"w": np.zeros(())}
     rng = np.random.default_rng(0)
@@ -66,7 +68,14 @@ def player(rollout_q: queue.Queue, param_q: queue.Queue, total_steps: int) -> No
         except queue.Empty:
             pass
         rollout = {"obs": rng.normal(size=(8, 4)), "reward": rng.normal(size=(8,))}
-        rollout_q.put(rollout)  # bounded: applies backpressure if the learner lags
+        while True:  # bounded put applies backpressure — but never outlive a dead learner
+            if not learner_thread.is_alive():
+                raise RuntimeError("learner thread died; aborting player")
+            try:
+                rollout_q.put(rollout, timeout=1.0)
+                break
+            except queue.Full:
+                continue
     rollout_q.put(None)
 
 
@@ -76,9 +85,11 @@ def main() -> None:
     stop = threading.Event()
     t = threading.Thread(target=learner, args=(rollout_q, param_q, stop), daemon=True)
     t.start()
-    player(rollout_q, param_q, total_steps=32)
+    try:
+        player(rollout_q, param_q, total_steps=32, learner_thread=t)
+    finally:
+        stop.set()  # before join: the event is what makes the learner exit
     t.join(timeout=30)
-    stop.set()
     print("decoupled template finished")
 
 

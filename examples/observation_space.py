@@ -21,8 +21,9 @@ def main() -> None:
     overrides = ["algo.total_steps=1", "algo.per_rank_batch_size=1", "buffer.size=1", *overrides]
     cfg = compose(overrides=overrides)
     if not (cfg.algo.cnn_keys.encoder or cfg.algo.mlp_keys.encoder):
+        # vector keys only by default: requesting "rgb" from a vector-only env would
+        # drag in a render-based pixel pipeline (and pygame) just to print the space
         cfg.algo.mlp_keys.encoder = ["state"]
-        cfg.algo.cnn_keys.encoder = ["rgb"]
     env = make_env(cfg, seed=cfg.seed, rank=0)()
     try:
         print(f"env.id          = {cfg.env.id}")

@@ -237,7 +237,8 @@ def agents(args: Optional[List[str]] = None) -> None:
             )
         )
     headers = ("algorithm", "module", "decoupled", "evaluable")
-    widths = [max(len(h), *(len(r[i]) for r in rows)) for i, h in enumerate(headers)]
+    widths = [max((len(r[i]) for r in rows), default=0) for i in range(len(headers))]
+    widths = [max(w, len(h)) for w, h in zip(widths, headers)]
     line = "  ".join(h.ljust(w) for h, w in zip(headers, widths))
     print(line)
     print("-" * len(line))

@@ -27,3 +27,32 @@ import pytest  # noqa: E402
 def tmp_logs(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     return tmp_path
+
+
+@pytest.fixture(autouse=True)
+def no_env_var_leaks():
+    """Reference test strategy (``tests/conftest.py:26-60``): a test that mutates the
+    framework's environment knobs without cleaning up poisons every test after it —
+    fail loudly on the offender instead.  Scoped to the prefixes the framework reads
+    (libraries set unrelated vars as import side effects; that's not a leak), minus
+    the keys the harness itself manages."""
+    exempt = {"XLA_FLAGS", "JAX_PLATFORMS", "SHEEPRL_TPU_QUIET"}
+    prefixes = ("SHEEPRL", "MLFLOW", "JAX_", "XLA_")
+
+    def snapshot():
+        return {
+            k: v
+            for k, v in os.environ.items()
+            if k.startswith(prefixes) and k not in exempt
+        }
+
+    before = snapshot()
+    yield
+    after = snapshot()
+    added = set(after) - set(before)
+    removed = set(before) - set(after)
+    changed = {k for k in set(before) & set(after) if before[k] != after[k]}
+    assert not (added or removed or changed), (
+        f"test leaked environment variables: added={sorted(added)} "
+        f"removed={sorted(removed)} changed={sorted(changed)}"
+    )

@@ -2,6 +2,7 @@
 sign-flipped advantage or KL — these assert that learning actually HAPPENS.
 
 * PPO on CartPole-v1 must clearly beat a random policy within a small step budget;
+* SAC on Pendulum-v1 must clearly beat a random policy within a small step budget;
 * a Dreamer (V1/V2/V3) world-model loss must strictly decrease when the jitted train
   step is iterated on a fixed synthetic batch.
 """
@@ -144,3 +145,36 @@ def test_dreamer_world_model_loss_decreases(algo):
     assert np.isfinite(losses).all(), f"non-finite world-model loss: {losses}"
     first, last = np.mean(losses[:3]), np.mean(losses[-3:])
     assert last < first, f"{algo} world-model loss did not decrease: {first:.2f} -> {last:.2f}"
+
+
+def test_sac_pendulum_learns(tmp_path):
+    """Random Pendulum-v1 policy averages about -1200/episode; a correctly-signed SAC
+    (critic TD target, reparameterized actor, alpha) must clearly beat that within a
+    small step budget."""
+    run(
+        [
+            "exp=sac",
+            "env=gym",
+            "env.id=Pendulum-v1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.total_steps=6144",
+            "algo.learning_starts=512",
+            "algo.replay_ratio=1",
+            "algo.per_rank_batch_size=128",
+            "algo.dense_units=64",
+            "algo.mlp_layers=2",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            "metric.log_every=512",
+            f"log_root={tmp_path}",
+            "buffer.size=50000",
+            "buffer.memmap=False",
+        ]
+    )
+    test_reward = _tb_scalar(tmp_path, "Test/cumulative_reward")[-1]
+    train_rewards = _tb_scalar(tmp_path, "Rewards/rew_avg")
+    best = max(max(train_rewards), test_reward)
+    assert best >= -900.0, f"SAC failed to learn Pendulum: best avg reward {best:.1f} (< -900)"
