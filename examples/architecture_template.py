@@ -76,7 +76,12 @@ def player(
                 break
             except queue.Full:
                 continue
-    rollout_q.put(None)
+    while learner_thread.is_alive():  # same guard for the shutdown sentinel
+        try:
+            rollout_q.put(None, timeout=1.0)
+            break
+        except queue.Full:
+            continue
 
 
 def main() -> None:

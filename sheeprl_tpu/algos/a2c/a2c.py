@@ -125,7 +125,7 @@ def main(ctx, cfg) -> None:
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
                 obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
-                env_act, _, logprob, value = act_fn(params, obs_t, ctx.rng())
+                env_act, _, logprob, value = act_fn(params, obs_t, ctx.local_rng())
                 env_act_np = np.asarray(jax.device_get(env_act))
                 if is_continuous:
                     low, high = act_space.low, act_space.high

@@ -180,7 +180,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
         with timer("Time/env_interaction_time"):
             obs_t = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
             actions, stored, player_state = player_jit(
-                player_params(), player_state, obs_t, jnp.asarray(is_first_np), ctx.rng(), jnp.asarray(expl_amount)
+                player_params(), player_state, obs_t, jnp.asarray(is_first_np), ctx.local_rng(), jnp.asarray(expl_amount)
             )
             stored_actions = np.asarray(jax.device_get(stored))
             acts_np = [np.asarray(jax.device_get(a)) for a in actions]

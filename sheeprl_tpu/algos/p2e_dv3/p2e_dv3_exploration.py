@@ -555,7 +555,7 @@ def main(ctx, cfg) -> None:
             else:
                 obs_t = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
                 actions, stored, player_state = player_jit(
-                    player_params(), player_state, obs_t, jnp.asarray(is_first_np), ctx.rng()
+                    player_params(), player_state, obs_t, jnp.asarray(is_first_np), ctx.local_rng()
                 )
                 stored_actions = np.asarray(jax.device_get(stored))
                 acts_np = [np.asarray(jax.device_get(a)) for a in actions]

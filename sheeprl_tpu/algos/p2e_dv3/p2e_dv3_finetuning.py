@@ -208,7 +208,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             # no random prefill, the agent is pretrained (reference :330-:352).
             obs_t = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
             actions, stored, player_state = player_jit(
-                player_params(), player_state, obs_t, jnp.asarray(is_first_np), ctx.rng()
+                player_params(), player_state, obs_t, jnp.asarray(is_first_np), ctx.local_rng()
             )
             stored_actions = np.asarray(jax.device_get(stored))
             acts_np = [np.asarray(jax.device_get(a)) for a in actions]

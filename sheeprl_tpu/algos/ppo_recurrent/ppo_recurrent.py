@@ -177,7 +177,7 @@ def main(ctx, cfg) -> None:
             for _ in range(rollout_steps):
                 obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
                 env_act, logprob, value, lstm_state = act_fn(
-                    params, obs_t, jnp.asarray(prev_stored), jnp.asarray(is_first_np), lstm_state, ctx.rng()
+                    params, obs_t, jnp.asarray(prev_stored), jnp.asarray(is_first_np), lstm_state, ctx.local_rng()
                 )
                 env_act_np = np.asarray(jax.device_get(env_act))
                 if is_continuous:
@@ -231,7 +231,7 @@ def main(ctx, cfg) -> None:
         local = rb.to_tensor()
         obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
         _, _, next_value, _ = act_fn(
-            params, obs_t, jnp.asarray(prev_stored), jnp.asarray(is_first_np), lstm_state, ctx.rng()
+            params, obs_t, jnp.asarray(prev_stored), jnp.asarray(is_first_np), lstm_state, ctx.local_rng()
         )
         returns, advantages = gae_fn(local["rewards"], local["values"], local["dones"], next_value[:, None])
         seq_data = {

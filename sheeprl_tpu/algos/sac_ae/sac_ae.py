@@ -269,7 +269,7 @@ def main(ctx, cfg) -> None:
                 tanh_actions = 2 * (actions - act_low) / (act_high - act_low) - 1 if rescale else actions
             else:
                 img = jnp.asarray(_img(obs) / 255.0)
-                tanh_actions = np.asarray(jax.device_get(act_fn(params, img, ctx.rng())))
+                tanh_actions = np.asarray(jax.device_get(act_fn(params, img, ctx.local_rng())))
                 actions = act_low + (tanh_actions + 1) * 0.5 * (act_high - act_low) if rescale else tanh_actions
             next_obs, reward, terminated, truncated, info = envs.step(actions)
             done = np.logical_or(terminated, truncated)

@@ -64,6 +64,7 @@ class MeshContext:
     precision: str = "bf16-mixed"
     seed: int = 42
     _rng_key: Optional[jax.Array] = field(default=None, repr=False)
+    _local_rng_key: Optional[jax.Array] = field(default=None, repr=False)
 
     # -- topology -----------------------------------------------------------
     @property
@@ -166,10 +167,28 @@ class MeshContext:
 
     # -- rng ----------------------------------------------------------------
     def rng(self) -> jax.Array:
-        """Split a fresh PRNG key off the context's chain (host-side bookkeeping)."""
+        """Split a fresh key off the PROCESS-IDENTICAL chain (seeded with ``seed``
+        alone).  Use for parameter initialisation and jitted train-step keys: with
+        replicated params, every process must feed the SPMD program the same
+        replicated inputs, or the replicas diverge (and ``device_put`` with a
+        replicated sharding asserts on the mismatch)."""
         if self._rng_key is None:
-            self._rng_key = jax.random.PRNGKey(self.seed + jax.process_index())
+            self._rng_key = jax.random.PRNGKey(self.seed)
         self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    def local_rng(self) -> jax.Array:
+        """Split a fresh key off the PER-PROCESS chain (``seed + process_index``).
+        Use for env-side action sampling and anything that should explore
+        differently on each rank (the analogue of the reference's per-rank torch
+        seeding)."""
+        if self._local_rng_key is None:
+            # fold_in decorrelates this chain from the shared one even on process 0
+            # (a bare ``seed + process_index`` would alias the shared chain there).
+            self._local_rng_key = jax.random.fold_in(
+                jax.random.PRNGKey(self.seed), 0x5EED + jax.process_index()
+            )
+        self._local_rng_key, sub = jax.random.split(self._local_rng_key)
         return sub
 
     # -- host-object exchange (reference: TorchCollective over gloo) --------
