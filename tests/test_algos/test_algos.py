@@ -1,6 +1,7 @@
 """End-to-end dry-run smoke tests through the real CLI, per algorithm × dummy env
 (the reference's dominant test pattern, ``tests/test_algos/test_algos.py:21-566``)."""
 
+import os
 import pytest
 
 from sheeprl_tpu.cli import run
@@ -395,3 +396,31 @@ def test_agents_listing(capsys):
     out = capsys.readouterr().out
     assert "dreamer_v3" in out and "sac_decoupled" in out
     assert "decoupled" in out.splitlines()[0]
+
+
+def test_module_launchers_wired(tmp_path):
+    """`python -m sheeprl_tpu` / `.eval` / `.registration` must resolve as modules
+    (reference ships sheeprl.py / sheeprl_eval.py / sheeprl_model_manager.py
+    launchers); a missing module file dies at interpreter start, before any test
+    that imports the functions directly would notice."""
+    import subprocess
+    import sys
+
+    for mod, needle in (
+        ("sheeprl_tpu", "exp="),  # usage error mentions config selection
+        ("sheeprl_tpu.eval", "checkpoint_path"),
+        ("sheeprl_tpu.registration", "checkpoint_path"),
+    ):
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        proc = subprocess.run(
+            [sys.executable, "-m", mod],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=repo_root,  # module resolution must not depend on pytest's cwd
+            env={**os.environ, "SHEEPRL_TPU_QUIET": "1"},
+        )
+        blob = proc.stdout + proc.stderr
+        assert proc.returncode != 0  # no args -> usage/validation error, not ImportError
+        assert "No module named" not in blob, f"{mod} launcher missing: {blob[-500:]}"
+        assert needle in blob, f"{mod} did not print its usage hint: {blob[-500:]}"

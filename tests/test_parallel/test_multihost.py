@@ -64,9 +64,11 @@ CHILD = textwrap.dedent(
 ).format(repo=str(REPO))
 
 
-def test_two_process_multihost(tmp_path):
+def _run_two_children(script_text, tmp_path, timeout, ok_marker):
+    """Launch the child script as 2 coordinated JAX processes; assert both exit 0
+    and print their ``<ok_marker> <pid> OK`` line. Returns the child outputs."""
     script = tmp_path / "child.py"
-    script.write_text(CHILD)
+    script.write_text(script_text)
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -84,7 +86,7 @@ def test_two_process_multihost(tmp_path):
     try:
         for p in procs:
             try:
-                out, _ = p.communicate(timeout=300)
+                out, _ = p.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
                 # One child died and its sibling is stuck in a collective: reap both
                 # so we can show the FAILED child's diagnostics instead of a timeout.
@@ -99,8 +101,13 @@ def test_two_process_multihost(tmp_path):
                 p.kill()
                 p.communicate()
     for pid, (p, out) in enumerate(zip(procs, outputs)):
-        assert p.returncode == 0, f"child {pid} failed:\n{out[-3000:]}"
-        assert f"child {pid} OK" in out
+        assert p.returncode == 0, f"{ok_marker} {pid} failed:\n{out[-3000:]}"
+        assert f"{ok_marker} {pid} OK" in out
+    return outputs
+
+
+def test_two_process_multihost(tmp_path):
+    _run_two_children(CHILD, tmp_path, timeout=300, ok_marker="child")
 
 
 TRAIN_CHILD = textwrap.dedent(
@@ -143,40 +150,7 @@ def test_two_process_dreamer_v3_training(tmp_path):
     reference's LT_DEVICES=2 equivalent, end-to-end): batch sharded over the global
     data axis, GSPMD gradient all-reduce across processes, rank-0 logging, per-rank
     buffer checkpoint shards."""
-    script = tmp_path / "train_child.py"
-    script.write_text(TRAIN_CHILD)
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    coordinator = f"127.0.0.1:{port}"
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), coordinator, str(pid), str(tmp_path)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for pid in (0, 1)
-    ]
-    outputs = []
-    try:
-        for p in procs:
-            try:
-                out, _ = p.communicate(timeout=540)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    if q.poll() is None:
-                        q.kill()
-                out, _ = p.communicate()
-            outputs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
-    for pid, (p, out) in enumerate(zip(procs, outputs)):
-        assert p.returncode == 0, f"train child {pid} failed:\n{out[-3000:]}"
-        assert f"train child {pid} OK" in out
+    _run_two_children(TRAIN_CHILD, tmp_path, timeout=540, ok_marker="train child")
     ckpts = sorted((tmp_path / "logs").rglob("ckpt_*"))
     assert ckpts, "no checkpoint written by the 2-process run"
     events = sorted((tmp_path / "logs").rglob("events.out.tfevents.*"))
