@@ -467,3 +467,62 @@ def test_dreamer_v3_tensor_parallel_cli(tmp_path):
     ckpts = _ckpts(tmp_path)
     assert ckpts
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+def test_droq_evaluate_roundtrip(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    # droq shares SAC's dummy-env settings; only the exp differs
+    run(["exp=droq"] + SAC_ARGS[1:] + standard_args(tmp_path, extra=["dry_run=False"]))
+    ckpts = _ckpts(tmp_path)
+    assert ckpts
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+def test_ppo_recurrent_evaluate_roundtrip(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(
+        [
+            "exp=ppo_recurrent",
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=8",
+            "algo.per_rank_num_batches=2",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.rnn.lstm.hidden_size=8",
+            "algo.mlp_layers=1",
+            "algo.total_steps=32",
+        ]
+        + standard_args(tmp_path)
+    )
+    ckpts = _ckpts(tmp_path)
+    assert ckpts
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+def test_sac_ae_evaluate_roundtrip(tmp_path):
+    from sheeprl_tpu.cli import evaluate
+
+    run(
+        [
+            "exp=sac_ae",
+            "env=continuous_dummy",
+            "env.screen_size=32",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.encoder.features_dim=8",
+            "algo.encoder.channels=4",
+            "algo.actor.dense_units=8",
+            "algo.critic.dense_units=8",
+            "algo.per_rank_batch_size=4",
+            "algo.learning_starts=4",
+            "algo.total_steps=16",
+            "buffer.size=256",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    ckpts = _ckpts(tmp_path)
+    assert ckpts
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
