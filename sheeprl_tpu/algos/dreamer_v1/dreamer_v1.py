@@ -205,9 +205,6 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys):
 
 @register_algorithm(name="dreamer_v1")
 def main(ctx, cfg) -> None:
-    # The DV1 decoder geometry is pinned to 64×64, like DV2.
-    cfg.env.screen_size = 64
-    cfg.env.frame_stack = 1
     rank = ctx.process_index
     log_dir = get_log_dir(cfg)
     if ctx.is_global_zero:

@@ -285,9 +285,6 @@ def make_buffer(cfg, num_envs, obs_keys, log_dir, rank, world):
 
 @register_algorithm(name="dreamer_v2")
 def main(ctx, cfg) -> None:
-    # The DV2 decoder geometry is pinned to 64×64 (reference dreamer_v2.py:399-400).
-    cfg.env.screen_size = 64
-    cfg.env.frame_stack = 1
     rank = ctx.process_index
     log_dir = get_log_dir(cfg)
     if ctx.is_global_zero:

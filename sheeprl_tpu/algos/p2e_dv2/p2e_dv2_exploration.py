@@ -331,8 +331,6 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
 
 @register_algorithm(name="p2e_dv2_exploration")
 def main(ctx, cfg) -> None:
-    cfg.env.screen_size = 64
-    cfg.env.frame_stack = 1
     rank = ctx.process_index
     log_dir = get_log_dir(cfg)
     if ctx.is_global_zero:

@@ -36,8 +36,6 @@ from sheeprl_tpu.utils.utils import Ratio
 def main(ctx, cfg, exploration_cfg=None) -> None:
     if exploration_cfg is None:
         exploration_cfg = load_exploration_config(cfg)
-    cfg.env.screen_size = 64
-    cfg.env.frame_stack = 1
     rank = ctx.process_index
     log_dir = get_log_dir(cfg)
     if ctx.is_global_zero:

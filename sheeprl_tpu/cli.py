@@ -62,6 +62,17 @@ def check_configs(cfg: DotDict) -> None:
         raise ValueError("algo.cnn_keys.encoder and algo.mlp_keys.encoder must be lists")
     if cfg.metric.get("log_level", 1) not in (0, 1):
         raise ValueError(f"Invalid metric.log_level: {cfg.metric.log_level}")
+    # DV1/DV2 (and their P2E variants) pin the decoder geometry to 64×64 single-frame
+    # (reference dreamer_v2.py:399-400).  Validate instead of silently overwriting the
+    # user's config, so the saved config.yaml never contradicts the CLI.
+    if str(algo.get("name", "")).startswith(("dreamer_v1", "dreamer_v2", "p2e_dv1", "p2e_dv2")) and cnn_keys:
+        if int(cfg.env.get("screen_size") or 64) != 64 or int(cfg.env.get("frame_stack") or 1) > 1:
+            raise ValueError(
+                f"{algo['name']} pixel observations require env.screen_size=64 and "
+                f"env.frame_stack<=1 (the decoder geometry is pinned to one 64x64 frame); "
+                f"got screen_size={cfg.env.get('screen_size')}, "
+                f"frame_stack={cfg.env.get('frame_stack')}."
+            )
     # Sequence-sampling algorithms: the prefill must leave every env's sub-buffer with
     # at least one full sequence, or the first train iteration dies mid-run with a
     # sampling error.  Prefill iterations (= rows per env) are
@@ -74,7 +85,17 @@ def check_configs(cfg: DotDict) -> None:
         cfg.get("buffer", {}).get("load_from_exploration", False)
     )
     if seq_len > 1 and learning_starts > 0 and not buffer_prefilled and not cfg.get("dry_run", False):
-        world = int(cfg.get("mesh", {}).get("distributed", {}).get("num_processes") or 1)
+        dist = cfg.get("mesh", {}).get("distributed", {}) or {}
+        # Multi-process launches configured through a cluster launcher leave
+        # num_processes null and let jax.distributed auto-detect: fall back to the
+        # launcher env vars so the guard doesn't underestimate world as 1.  Only
+        # trust them when a coordinator_address shows this run IS distributed —
+        # a single-process run inside a SLURM/MPI allocation must not be rejected.
+        world = int(dist.get("num_processes") or 1)
+        if dist.get("coordinator_address") and not dist.get("num_processes"):
+            world = int(
+                os.environ.get("SLURM_NTASKS") or os.environ.get("OMPI_COMM_WORLD_SIZE") or 1
+            )
         steps_per_iter = max(cfg.env.num_envs * world * max(cfg.env.action_repeat, 1), 1)
         rows_per_env = learning_starts // steps_per_iter
         if rows_per_env < seq_len:

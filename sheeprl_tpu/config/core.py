@@ -247,6 +247,9 @@ class Composer:
         # their siblings (e.g. an inherited parent exp) are being processed.
         self.scoped_overrides: Dict[str, Any] = {}
         self.applied_groups: set = set()
+        # Groups declared mandatory (``???``) somewhere in the tree: a later
+        # ``override group:`` entry is the legitimate way to satisfy them.
+        self.mandatory_groups: set = set()
         # group -> option actually loaded; a group is re-loaded only when the
         # effective option differs (re-merging the same option after an exp's
         # content would clobber the exp's value overrides with group defaults).
@@ -266,10 +269,20 @@ class Composer:
         overrides_here: List[tuple] = []
         plain: List[Any] = []
         for entry in defaults:
-            if isinstance(entry, dict) and any(str(g).startswith("override") for g in entry):
+            # Classify per key: only keys of the form "override <group>" /
+            # "override/<group>" are overrides.  A mixed dict entry keeps its plain
+            # keys as plain selections, and a group whose name merely begins with
+            # "override" (no separator) is a plain group, never truncated.
+            if isinstance(entry, dict):
+                plain_part: Dict[Any, Any] = {}
                 for group, option in entry.items():
-                    group = str(group)[len("override") :].strip().lstrip("/")
-                    overrides_here.append((group, option))
+                    g = str(group)
+                    if g.startswith("override ") or g.startswith("override/"):
+                        overrides_here.append((g[len("override") :].strip().lstrip("/"), option))
+                    else:
+                        plain_part[group] = option
+                if plain_part:
+                    plain.append(plain_part)
             else:
                 plain.append(entry)
         pushed = []
@@ -282,8 +295,18 @@ class Composer:
             for entry in plain:
                 self._apply_default(cfg, entry, parent_group=parent_group)
             # Override entries whose effective option no sibling loaded (directly or
-            # via this scope's redirection): load them here, in order.
+            # via this scope's redirection): if the group exists anywhere in the
+            # defaults tree processed so far (loaded earlier, e.g. by the root
+            # config, or recorded as a mandatory ``???`` group), re-select it here.
+            # A group that exists NOWHERE is an error, matching Hydra ("could not
+            # find match for override") — catches typos like ``override /enviro:``.
             for group, option in overrides_here:
+                if group not in self.applied_groups and group not in self.mandatory_groups:
+                    raise ValueError(
+                        f"Defaults-list override 'override /{group}: {option}' matches no "
+                        f"'{group}' entry in the defaults tree. Overrides re-select an "
+                        f"existing entry; use a plain '{group}: {option}' entry to add one."
+                    )
                 self._select_and_load(cfg, group, option)
         finally:
             for group in pushed:
@@ -342,6 +365,7 @@ class Composer:
             return
         if str(option).startswith("???"):
             # Mandatory group: must be chosen by an override; record it.
+            self.mandatory_groups.add(group)
             cfg.setdefault("_mandatory_groups_", []).append(group)
             return
         if self.applied_options.get(group) == str(option):

@@ -105,9 +105,12 @@ def make_replay_prefetcher(rb, ctx, cfg, batch_size: int, sequence_length: int):
     import jax
     import numpy as np
 
+    sharded = ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
+    if ctx.data_parallel_size > 1 and not sharded:
+        ctx.warn_replication_fallback(f"replay batch_size={batch_size}")
     sharding = (
         ctx.batch_sharding(1)  # [T, B, ...] slices: batch axis 1 over the data mesh
-        if ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
+        if sharded
         else None
     )
 

@@ -65,6 +65,7 @@ class MeshContext:
     seed: int = 42
     _rng_key: Optional[jax.Array] = field(default=None, repr=False)
     _local_rng_key: Optional[jax.Array] = field(default=None, repr=False)
+    _warned_replication: bool = field(default=False, repr=False)
 
     # -- topology -----------------------------------------------------------
     @property
@@ -123,7 +124,8 @@ class MeshContext:
         gets this implicitly from DDP's per-process batches).  Falls back to
         replication per-leaf when the batch axis doesn't divide the mesh — e.g. tiny
         dry-run batches on the 8-device CI mesh — so loops never crash on shape edge
-        cases.
+        cases.  The fallback is a perf cliff (1-chip scaling on a multi-chip mesh),
+        so it warns once per run.
         """
         dp = self.data_parallel_size
         sh = self.batch_sharding(batch_axis)
@@ -131,9 +133,29 @@ class MeshContext:
 
         def _put(x):
             divisible = x.ndim > batch_axis and x.shape[batch_axis] % dp == 0
+            if dp > 1 and not divisible:
+                self.warn_replication_fallback(
+                    f"batch axis {batch_axis} of shape {getattr(x, 'shape', '?')}"
+                )
             return jax.device_put(x, sh if (dp > 1 and divisible) else rep)
 
         return jax.tree.map(_put, tree)
+
+    def warn_replication_fallback(self, what: str) -> None:
+        """Emit the 1-chip-scaling warning at most once per context."""
+        if self._warned_replication:
+            return
+        self._warned_replication = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "put_batch: %s does not divide the data mesh axis (data=%d); the batch is "
+            "REPLICATED, so training scales like a single chip. Make the batch size a "
+            "multiple of the data axis (or shrink mesh.data) to restore data-parallel "
+            "scaling.",
+            what,
+            self.data_parallel_size,
+        )
 
     def replicate(self, tree: Any) -> Any:
         return jax.device_put(tree, self.replicated)

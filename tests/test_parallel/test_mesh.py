@@ -59,3 +59,23 @@ def test_precision_policy():
     assert ctx.param_dtype == jnp.float32
     ctx = MeshContext(mesh=build_mesh(), precision="32-true")
     assert ctx.compute_dtype == jnp.float32
+
+
+def test_put_batch_replication_fallback_warns_once(caplog):
+    """dp>1 with a non-dividing batch must warn (once): silent replication is a perf
+    cliff — a multi-chip mesh scaling like one chip with no message (VERDICT r2 #5)."""
+    import logging
+
+    ctx = MeshContext(mesh=build_mesh())  # 8-way data mesh
+    with caplog.at_level(logging.WARNING, logger="sheeprl_tpu.parallel.mesh"):
+        ctx.put_batch({"x": np.zeros((3, 2), np.float32)})  # 3 % 8 != 0
+        ctx.put_batch({"x": np.zeros((5, 2), np.float32)})
+    warnings = [r for r in caplog.records if "REPLICATED" in r.message]
+    assert len(warnings) == 1  # once per run, not per call
+
+    caplog.clear()
+    ctx2 = MeshContext(mesh=build_mesh())
+    with caplog.at_level(logging.WARNING, logger="sheeprl_tpu.parallel.mesh"):
+        out = ctx2.put_batch({"x": np.zeros((16, 2), np.float32)})
+    assert not [r for r in caplog.records if "REPLICATED" in r.message]
+    assert "data" in str(out["x"].sharding.spec)  # actually sharded

@@ -82,6 +82,47 @@ def test_every_exp_preset_composes():
         check_configs(cfg)  # incl. the prefill-vs-sequence-length guard
 
 
+def test_override_prefix_requires_separator(tmp_path, monkeypatch):
+    """A group whose name merely begins with 'override' is a plain group selection,
+    never truncated; only 'override <group>' / 'override/<group>' keys are overrides."""
+    group_dir = tmp_path / "overriders"
+    group_dir.mkdir()
+    (group_dir / "a.yaml").write_text("x: 1\n")
+    exp_dir = tmp_path / "exp"
+    exp_dir.mkdir()
+    (exp_dir / "custom.yaml").write_text("defaults:\n  - ppo_dummy\n  - overriders: a\n")
+    monkeypatch.setenv("SHEEPRL_TPU_SEARCH_PATH", str(tmp_path))
+    cfg = compose(overrides=["exp=custom"])
+    assert cfg.overriders.x == 1
+
+
+def test_mixed_defaults_entry_classified_per_key(tmp_path, monkeypatch):
+    """A dict defaults entry mixing an override key with a plain group key keeps the
+    plain key intact (not mangled to the empty group)."""
+    exp_dir = tmp_path / "exp"
+    exp_dir.mkdir()
+    (exp_dir / "custom.yaml").write_text(
+        "defaults:\n  - {override /algo: ppo, env: dummy}\n"
+        "seed: 5\nbuffer:\n  size: 64\nalgo:\n  total_steps: 64\n  per_rank_batch_size: 4\n"
+    )
+    monkeypatch.setenv("SHEEPRL_TPU_SEARCH_PATH", str(tmp_path))
+    cfg = compose(overrides=["exp=custom"])
+    assert cfg.algo.name == "ppo"
+    assert cfg.env.id == "discrete_dummy"
+    assert cfg.seed == 5
+
+
+def test_unmatched_override_raises(tmp_path, monkeypatch):
+    """An override targeting a group that exists nowhere in the defaults tree errors
+    (Hydra: 'could not find match for override') instead of silently loading last."""
+    exp_dir = tmp_path / "exp"
+    exp_dir.mkdir()
+    (exp_dir / "custom.yaml").write_text("defaults:\n  - ppo_dummy\n  - override /enviro: dummy\n")
+    monkeypatch.setenv("SHEEPRL_TPU_SEARCH_PATH", str(tmp_path))
+    with pytest.raises(ValueError, match="matches no 'enviro' entry"):
+        compose(overrides=["exp=custom"])
+
+
 def test_exp_inheriting_exp_keeps_concrete_values():
     """``override /algo:`` in a child exp re-selects the option the parent exp's
     defaults load — it must NOT re-merge the algo group file after the parent exp's
@@ -96,3 +137,15 @@ def test_exp_inheriting_exp_keeps_concrete_values():
     cfg = compose(overrides=["exp=dreamer_v3_100k_ms_pacman", "algo=dreamer_v3_M"])
     assert cfg.algo.world_model.recurrent_model.recurrent_state_size == 1024  # M size
     assert cfg.algo.per_rank_batch_size == 16
+
+
+def test_dv1_dv2_pixel_geometry_validated_not_mutated():
+    """DV1/DV2 pixel presets require screen_size=64/frame_stack<=1; the CLI validates
+    instead of silently overwriting, so the saved config never contradicts the user."""
+    from sheeprl_tpu.cli import _import_algorithms, check_configs
+
+    _import_algorithms()
+    for exp in ("dreamer_v1_dummy", "dreamer_v2_dummy"):
+        check_configs(compose(overrides=[f"exp={exp}"]))  # shipped presets pass
+        with pytest.raises(ValueError, match="screen_size"):
+            check_configs(compose(overrides=[f"exp={exp}", "env.screen_size=128"]))
