@@ -171,9 +171,13 @@ def bench_e2e():
                 "buffer.size=100000",
                 "buffer.memmap=False",
                 "buffer.checkpoint=False",
+                "buffer.device=True",  # HBM-resident replay: index-only sampling
                 "checkpoint.every=0",
                 "checkpoint.save_last=False",
-                "metric.log_every=1",
+                # Window of 16 iterations per log: the deferred-metrics design syncs
+                # only at the log cadence; log_every=1 would force a drain per
+                # iteration and measure the sync overhead instead of the loop.
+                "metric.log_every=64",
                 f"log_root={tmp}",
             ]
         )

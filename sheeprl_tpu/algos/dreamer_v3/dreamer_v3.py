@@ -56,6 +56,7 @@ from sheeprl_tpu.distributions import (
     SymlogDistribution,
     TwoHotEncodingDistribution,
 )
+from sheeprl_tpu.utils.blocks import BlockDispatcher, IndexedBlockDispatcher
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, make_aggregator, record_episode_stats
@@ -347,7 +348,44 @@ def main(ctx, cfg) -> None:
     # opt states mirror the params' (possibly tensor-parallel) placement
     opt_states = ctx.shard_params(init_opt_states(params))
     moments_state = ctx.replicate(init_moments())
-    train_jit = jax.jit(train_step, static_argnames=())
+    target_update_freq = cfg.algo.critic.per_rank_target_network_update_freq
+
+    # The whole iteration's gradient steps run as ONE jitted scan (utils/blocks.py):
+    # one dispatch per iteration, per-step keys split inside the jit, target-critic
+    # cadence computed from the running step count.
+    def _block_step(carry, batch, key, update_target):
+        params, opt_states, moments = carry
+        params, opt_states, moments, metrics = train_step(
+            params, opt_states, moments, batch, key, update_target
+        )
+        return (params, opt_states, moments), metrics
+
+    # Device-resident replay (buffer.device): rows live in HBM, the host ships only
+    # (env, start) indices, and each scan step gathers its batch in-jit — removes
+    # the host→device batch traffic that otherwise floors e2e throughput.  Falls
+    # back to host sampling + async prefetch under multi-chip data parallelism
+    # (the mirror is single-device) or when disabled.
+    use_device_buffer = bool(cfg.buffer.get("device", False))
+    if use_device_buffer and ctx.data_parallel_size > 1:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "buffer.device=True is single-chip only (the mirror is not sharded); "
+            "falling back to host-side sampling with the async prefetcher."
+        )
+        use_device_buffer = False
+    seq_len_cfg = cfg.algo.per_rank_sequence_length
+    if use_device_buffer:
+        from sheeprl_tpu.data.device_buffer import gather_sequences
+
+        dispatcher = IndexedBlockDispatcher(
+            _block_step,
+            gather_fn=lambda mirror, e, s: gather_sequences(mirror, e, s, seq_len_cfg),
+            target_update_freq=target_update_freq,
+            base_key=ctx.rng(),
+        )
+    else:
+        dispatcher = BlockDispatcher(_block_step, target_update_freq, base_key=ctx.rng())
 
     player_step = make_player_step(world_model, actor, actions_dim, cfg.algo.world_model.discrete_size)
     player_jit = jax.jit(player_step, static_argnames=("greedy",))
@@ -372,6 +410,18 @@ def main(ctx, cfg) -> None:
     )
     rb.seed(cfg.seed + rank)
 
+    mirror = None
+    if use_device_buffer:
+        from sheeprl_tpu.data.device_buffer import make_mirror_for
+
+        mirror = make_mirror_for(
+            rb,
+            cnn_keys,
+            mlp_keys,
+            obs_space,
+            [("actions", act_dim_sum), ("rewards", 1), ("terminated", 1), ("truncated", 1), ("is_first", 1)],
+        )
+
     # rank-independent (cross-process gathering) when multi-host
     aggregator = make_aggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
@@ -384,7 +434,6 @@ def main(ctx, cfg) -> None:
     total_steps = int(cfg.algo.total_steps)
     num_iters = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
     learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
-    target_update_freq = cfg.algo.critic.per_rank_target_network_update_freq
 
     start_iter = 1
     policy_step = 0
@@ -412,6 +461,8 @@ def main(ctx, cfg) -> None:
         learning_starts += start_iter
         if cfg.buffer.checkpoint and "rb" in state:
             rb.load_state_dict(state["rb"])
+            if mirror is not None:
+                mirror.load_from(rb)
 
     # Pending-row storage (reference ``dreamer_v3.py:538-651``): row t holds obs_t
     # together with the reward/terminated/truncated received when ARRIVING at obs_t
@@ -429,8 +480,24 @@ def main(ctx, cfg) -> None:
         return row
 
     # Double-buffered sampling: the next [G, T, B] block is drawn + shipped to the
-    # device while the current block's gradient steps execute (SURVEY §7).
-    prefetcher, rb_lock, _sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
+    # device while the current block's gradient steps execute (SURVEY §7).  The
+    # device-resident mirror needs neither: sampling is index-only.
+    if use_device_buffer:
+        import contextlib
+
+        prefetcher, rb_lock, _sample_block = None, contextlib.nullcontext(), None
+    else:
+        prefetcher, rb_lock, _sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
+
+    def rb_add(data, indices=None, validate_args=False):
+        """Host add + device-mirror scatter (the mirror writes at each target
+        env's pre-add cursor)."""
+        if mirror is not None:
+            envs_sel = list(indices) if indices is not None else list(range(num_envs))
+            positions = [rb.buffer[e]._pos for e in envs_sel]
+            mirror.add(data, envs_sel, positions)
+        with rb_lock:
+            rb.add(data, indices=indices, validate_args=validate_args)
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
     player_state = player_state_init(num_envs)
@@ -444,6 +511,7 @@ def main(ctx, cfg) -> None:
 
     try:
         for iter_num in range(start_iter, num_iters + 1):
+            env_time = 0.0
             env_t0 = time.perf_counter()
             with timer("Time/env_interaction_time"):
                 if iter_num <= learning_starts and not cfg.checkpoint.get("resume_from"):
@@ -467,8 +535,11 @@ def main(ctx, cfg) -> None:
                     actions, stored, player_state = player_jit(
                         params, player_state, obs_t, jnp.asarray(is_first_np), ctx.local_rng()
                     )
-                    stored_actions = np.asarray(jax.device_get(stored))
-                    acts_np = [np.asarray(jax.device_get(a)) for a in actions]
+                    # ONE device_get for everything the host needs (per-array fetches
+                    # would each pay a transfer round trip on a remote accelerator).
+                    stored_np, acts_list = jax.device_get((stored, list(actions)))
+                    stored_actions = np.asarray(stored_np)
+                    acts_np = [np.asarray(a) for a in acts_list]
                     if is_continuous:
                         env_actions = acts_np[0]
                     elif len(actions_dim) == 1:
@@ -480,9 +551,44 @@ def main(ctx, cfg) -> None:
                 # (under the prefetcher's lock: the sampler thread must not read rows
                 # mid-write).
                 step_data["actions"] = stored_actions.reshape(1, num_envs, -1)
-                with rb_lock:
-                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                rb_add(step_data, validate_args=cfg.buffer.validate_args)
+            env_time += time.perf_counter() - env_t0
 
+            # ---- dispatch this iteration's gradient block BEFORE stepping the envs:
+            # the device executes it while the host walks the environments below
+            # (acting above used the params from the end of the previous iteration,
+            # exactly as the eager ordering did).  No device_get here — metrics are
+            # futures, fetched at the log cadence.
+            grad_steps = 0
+            if iter_num >= learning_starts:
+                grad_steps = ratio(
+                    (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
+                )
+                if grad_steps > 0:
+                    if mirror is not None:
+                        idx = [rb.sample_idx(batch_size, seq_len) for _ in range(grad_steps)]
+                        envs_idx = np.stack([e for e, _ in idx])
+                        starts_idx = np.stack([st for _, st in idx])
+                        params, opt_states, moments_state = dispatcher.dispatch(
+                            (params, opt_states, moments_state),
+                            mirror.arrays,
+                            envs_idx,
+                            starts_idx,
+                            cumulative_grad_steps,
+                        )
+                    else:
+                        sample = (
+                            prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
+                            if prefetcher is not None
+                            else _sample_block(grad_steps)
+                        )
+                        params, opt_states, moments_state = dispatcher.dispatch(
+                            (params, opt_states, moments_state), sample, cumulative_grad_steps
+                        )
+                    cumulative_grad_steps += grad_steps
+
+            env_t0 = time.perf_counter()
+            with timer("Time/env_interaction_time"):
                 next_obs, reward, terminated, truncated, info = envs.step(env_actions)
                 if cfg.env.clip_rewards:
                     reward = np.clip(reward, -1, 1)
@@ -514,8 +620,7 @@ def main(ctx, cfg) -> None:
                     reset_data["truncated"] = step_data["truncated"][:, done_idxs]
                     reset_data["actions"] = np.zeros((1, len(done_idxs), act_dim_sum), np.float32)
                     reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-                    with rb_lock:
-                        rb.add(reset_data, indices=done_idxs, validate_args=cfg.buffer.validate_args)
+                    rb_add(reset_data, indices=done_idxs, validate_args=cfg.buffer.validate_args)
                     # The pending row for reset envs starts a fresh episode.
                     step_data["rewards"][:, done_idxs] = 0.0
                     step_data["terminated"][:, done_idxs] = 0.0
@@ -526,43 +631,19 @@ def main(ctx, cfg) -> None:
                 obs = next_obs
                 policy_step += policy_steps_per_iter
                 record_episode_stats(aggregator, info)
-            env_time = time.perf_counter() - env_t0
-
-            train_time = 0.0
-            grad_steps = 0
-            if iter_num >= learning_starts:
-                grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
-                if grad_steps > 0:
-                    with timer("Time/train_time"):
-                        t0 = time.perf_counter()
-                        # [n_samples, T, B, ...] with B sharded over the data axis: the
-                        # jitted step then runs data-parallel with GSPMD gradient psums
-                        # (falls back to replication when B doesn't divide the mesh).
-                        sample = (
-                            prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
-                            if prefetcher is not None
-                            else _sample_block(grad_steps)
-                        )
-                        for g in range(grad_steps):
-                            batch = sample[g]
-                            cumulative_grad_steps += 1
-                            update_target = jnp.asarray(
-                                cumulative_grad_steps % target_update_freq == 0
-                            )
-                            params, opt_states, moments_state, train_metrics = train_jit(
-                                params, opt_states, moments_state, batch, ctx.rng(), update_target
-                            )
-                        train_metrics = jax.device_get(train_metrics)
-                        train_time = time.perf_counter() - t0
-                    for k, v in train_metrics.items():
-                        aggregator.update(k, float(v))
+            env_time += time.perf_counter() - env_t0
 
             if logger is not None and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
             ):
+                # The drain below is the window's only blocking sync: it waits for
+                # every gradient block dispatched in the window, so the window
+                # wall-clock is an honest end-to-end grad-steps/s denominator.
+                dispatcher.drain(aggregator)
                 metrics = aggregator.compute()
-                if train_time > 0:
-                    metrics["Time/sps_train"] = grad_steps / train_time
+                window_sps = dispatcher.pop_window_sps()
+                if window_sps is not None:
+                    metrics["Time/sps_train"] = window_sps
                 metrics["Time/sps_env_interaction"] = (
                     policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
                 )

@@ -11,6 +11,7 @@ steps of an iteration run in one ``lax.scan`` under jit."""
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from pathlib import Path
@@ -29,6 +30,8 @@ from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.models.blocks import MLP
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -239,6 +242,61 @@ def main(ctx, cfg) -> None:
     obs, _ = envs.reset(seed=cfg.seed + rank)
     step_data: Dict[str, np.ndarray] = {}
 
+    # Async host-side sampling + deferred metrics (see sac.py / utils/blocks.py):
+    # the worker ships the next [G, B] critic block and the actor batch while the
+    # device executes the current one; ``rb.add`` holds the sampler's lock.
+    def _sample_block(n: int):
+        sample = rb.sample(batch_size * n)
+        batches = {
+            "obs": np.concatenate([sample[k].reshape(n, batch_size, -1) for k in mlp_keys], -1),
+            "next_obs": np.concatenate(
+                [sample[f"next_{k}"].reshape(n, batch_size, -1) for k in mlp_keys], -1
+            ),
+            "actions": sample["actions"].reshape(n, batch_size, -1),
+            "rewards": sample["rewards"].reshape(n, batch_size, 1),
+            "dones": sample["dones"].reshape(n, batch_size, 1),
+        }
+        actor_sample = rb.sample(batch_size)
+        actor_batch = {
+            "obs": np.concatenate([actor_sample[k].reshape(batch_size, -1) for k in mlp_keys], -1)
+        }
+        return ctx.put_batch(batches, batch_axis=1), ctx.put_batch(actor_batch, batch_axis=0)
+
+    if cfg.algo.get("async_prefetch", True):
+        # Slice only the per-step critic block when reusing a staged bigger block;
+        # the actor batch has no step axis.
+        prefetcher = AsyncBatchPrefetcher(
+            _sample_block,
+            slice_fn=lambda block, n: (jax.tree.map(lambda x: x[:n], block[0]), block[1]),
+        )
+        rb_lock = prefetcher.lock
+    else:
+        prefetcher, rb_lock = None, contextlib.nullcontext()
+    futures = WindowedFutures()
+
+    def _dispatch_train(grad_steps: int, stage_next: bool) -> None:
+        nonlocal params, opt_state, cumulative_grad_steps
+        batches, actor_batch = (
+            prefetcher.get(grad_steps, stage_next=stage_next)
+            if prefetcher is not None
+            else _sample_block(grad_steps)
+        )
+        params, opt_state, c_loss_val = train_critics_fn(
+            params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+        )
+        params, opt_state, a_loss_val, t_loss_val = train_actor_fn(
+            params, opt_state, actor_batch, ctx.rng()
+        )
+        futures.track(
+            {
+                "Loss/value_loss": c_loss_val,
+                "Loss/policy_loss": a_loss_val,
+                "Loss/alpha_loss": t_loss_val,
+            },
+            grad_steps,
+        )
+        cumulative_grad_steps += grad_steps
+
     for iter_num in range(start_iter, num_iters + 1):
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
@@ -250,6 +308,25 @@ def main(ctx, cfg) -> None:
                 obs_t = prepare_obs(obs, mlp_keys)
                 tanh_actions = np.asarray(jax.device_get(act_fn(params["actor"], obs_t, ctx.local_rng())))
                 actions = act_low + (tanh_actions + 1) * 0.5 * (act_high - act_low) if rescale else tanh_actions
+        env_time = time.perf_counter() - env_t0
+
+        # Dispatch this iteration's gradient work BEFORE stepping the envs so the
+        # device trains while the host walks the environments; the first training
+        # iteration (empty buffer — rows carry next_obs) defers until the row lands.
+        grad_steps = 0
+        deferred_dispatch = False
+        if iter_num >= learning_starts:
+            grad_steps = ratio(
+                (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
+            )
+            if grad_steps > 0:
+                if rb.empty:
+                    deferred_dispatch = True
+                else:
+                    _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
+
+        env_t0 = time.perf_counter()
+        with timer("Time/env_interaction_time"):
             next_obs, reward, terminated, truncated, info = envs.step(actions)
             done = np.logical_or(terminated, truncated)
             real_next = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
@@ -264,52 +341,24 @@ def main(ctx, cfg) -> None:
             step_data["actions"] = tanh_actions.astype(np.float32)[None]
             step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
             step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            with rb_lock:
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
             obs = next_obs
             policy_step += policy_steps_per_iter
             record_episode_stats(aggregator, info)
-        env_time = time.perf_counter() - env_t0
+        env_time += time.perf_counter() - env_t0
 
-        train_time, grad_steps = 0.0, 0
-        if iter_num >= learning_starts:
-            grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
-            if grad_steps > 0:
-                sample = rb.sample(batch_size * grad_steps)
-                batches = {
-                    "obs": np.concatenate([sample[k].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1),
-                    "next_obs": np.concatenate(
-                        [sample[f"next_{k}"].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1
-                    ),
-                    "actions": sample["actions"].reshape(grad_steps, batch_size, -1),
-                    "rewards": sample["rewards"].reshape(grad_steps, batch_size, 1),
-                    "dones": sample["dones"].reshape(grad_steps, batch_size, 1),
-                }
-                batches = ctx.put_batch(batches, batch_axis=1)
-                actor_sample = rb.sample(batch_size)
-                actor_batch = ctx.put_batch(
-                    {"obs": np.concatenate([actor_sample[k].reshape(batch_size, -1) for k in mlp_keys], -1)},
-                    batch_axis=0,
-                )
-                with timer("Time/train_time"):
-                    t0 = time.perf_counter()
-                    params, opt_state, c_loss_val = train_critics_fn(
-                        params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
-                    )
-                    params, opt_state, a_loss_val, t_loss_val = train_actor_fn(
-                        params, opt_state, actor_batch, ctx.rng()
-                    )
-                    train_time = time.perf_counter() - t0
-                cumulative_grad_steps += grad_steps
-                aggregator.update("Loss/value_loss", float(jax.device_get(c_loss_val)))
-                aggregator.update("Loss/policy_loss", float(jax.device_get(a_loss_val)))
-                aggregator.update("Loss/alpha_loss", float(jax.device_get(t_loss_val)))
+        if deferred_dispatch:
+            _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
 
         if logger is not None and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
         ):
+            futures.drain(aggregator)  # the window's only blocking device sync
             metrics = aggregator.compute()
-            if train_time > 0:
-                metrics["Time/sps_train"] = grad_steps / train_time
+            window_sps = futures.pop_window_sps()
+            if window_sps is not None:
+                metrics["Time/sps_train"] = window_sps
             metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
             metrics["Params/replay_ratio"] = cumulative_grad_steps * world / policy_step if policy_step else 0.0
             logger.log_metrics(metrics, policy_step)
@@ -338,6 +387,8 @@ def main(ctx, cfg) -> None:
             last_checkpoint = policy_step
 
     envs.close()
+    if prefetcher is not None:
+        prefetcher.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(actor, params, ctx, cfg, log_dir)
         if logger is not None:

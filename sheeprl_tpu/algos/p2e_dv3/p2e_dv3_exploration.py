@@ -47,6 +47,7 @@ from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.prefetch import make_replay_prefetcher
+from sheeprl_tpu.utils.blocks import BlockDispatcher
 from sheeprl_tpu.distributions import (
     BernoulliSafeMode,
     Independent,
@@ -441,7 +442,21 @@ def main(ctx, cfg) -> None:
     )
     opt_states = ctx.shard_params(init_opt_states(params))
     moments_state = ctx.replicate(init_moments_state())
-    train_jit = jax.jit(train_step)
+    # One jitted scan per iteration's gradient block (utils/blocks.py); the EMA
+    # target cadence tests the count BEFORE the increment, as the eager loop did.
+    def _block_step(carry, batch, key, update_target):
+        params, opt_states, moments = carry
+        params, opt_states, moments, metrics = train_step(
+            params, opt_states, moments, batch, key, update_target
+        )
+        return (params, opt_states, moments), metrics
+
+    dispatcher = BlockDispatcher(
+        _block_step,
+        cfg.algo.critic.per_rank_target_network_update_freq,
+        count_offset=0,
+        base_key=ctx.rng(),
+    )
 
     player_step = make_player_step(world_model, actor, actions_dim, cfg.algo.world_model.discrete_size)
     player_jit = jax.jit(player_step, static_argnames=("greedy",))
@@ -557,8 +572,11 @@ def main(ctx, cfg) -> None:
                 actions, stored, player_state = player_jit(
                     player_params(), player_state, obs_t, jnp.asarray(is_first_np), ctx.local_rng()
                 )
-                stored_actions = np.asarray(jax.device_get(stored))
-                acts_np = [np.asarray(jax.device_get(a)) for a in actions]
+                # ONE device_get for everything the host needs (per-array fetches
+                # would each pay a transfer round trip on a remote accelerator).
+                stored_np, acts_list = jax.device_get((stored, list(actions)))
+                stored_actions = np.asarray(stored_np)
+                acts_np = [np.asarray(a) for a in acts_list]
                 if is_continuous:
                     env_actions = acts_np[0]
                 elif len(actions_dim) == 1:
@@ -569,7 +587,29 @@ def main(ctx, cfg) -> None:
             step_data["actions"] = stored_actions.reshape(1, num_envs, -1)
             with rb_lock:
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        env_time = time.perf_counter() - env_t0
 
+        # Dispatch this iteration's gradient block BEFORE stepping the envs: the
+        # device trains while the host walks the environments below (acting above
+        # used the previous iteration's params, exactly as the eager ordering did).
+        grad_steps = 0
+        if iter_num >= learning_starts:
+            grad_steps = ratio(
+                (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
+            )
+            if grad_steps > 0:
+                sample = (
+                    prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
+                    if prefetcher is not None
+                    else _sample_block(grad_steps)
+                )
+                params, opt_states, moments_state = dispatcher.dispatch(
+                    (params, opt_states, moments_state), sample, cumulative_grad_steps
+                )
+                cumulative_grad_steps += grad_steps
+
+        env_t0 = time.perf_counter()
+        with timer("Time/env_interaction_time"):
             next_obs, reward, terminated, truncated, info = envs.step(env_actions)
             if cfg.env.clip_rewards:
                 reward = np.clip(reward, -1, 1)
@@ -608,38 +648,16 @@ def main(ctx, cfg) -> None:
             obs = next_obs
             policy_step += policy_steps_per_iter
             record_episode_stats(aggregator, info)
-        env_time = time.perf_counter() - env_t0
-
-        train_time = 0.0
-        grad_steps = 0
-        if iter_num >= learning_starts:
-            grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
-            if grad_steps > 0:
-                with timer("Time/train_time"):
-                    t0 = time.perf_counter()
-                    sample = (
-                        prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
-                        if prefetcher is not None
-                        else _sample_block(grad_steps)
-                    )
-                    for g in range(grad_steps):
-                        batch = sample[g]
-                        update_target = jnp.asarray(cumulative_grad_steps % target_update_freq == 0)
-                        cumulative_grad_steps += 1
-                        params, opt_states, moments_state, train_metrics = train_jit(
-                            params, opt_states, moments_state, batch, ctx.rng(), update_target
-                        )
-                    train_metrics = jax.device_get(train_metrics)
-                    train_time = time.perf_counter() - t0
-                for k, v in train_metrics.items():
-                    aggregator.update(k, float(v))
+        env_time += time.perf_counter() - env_t0
 
         if logger is not None and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
         ):
+            dispatcher.drain(aggregator)  # the window's only blocking device sync
             metrics = aggregator.compute()
-            if train_time > 0:
-                metrics["Time/sps_train"] = grad_steps / train_time
+            window_sps = dispatcher.pop_window_sps()
+            if window_sps is not None:
+                metrics["Time/sps_train"] = window_sps
             metrics["Time/sps_env_interaction"] = (
                 policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
             )

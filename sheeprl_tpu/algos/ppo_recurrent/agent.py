@@ -21,9 +21,19 @@ import numpy as np
 
 from sheeprl_tpu.algos.ppo.agent import parse_action_space
 from sheeprl_tpu.models.blocks import MLP, MultiEncoder
+from sheeprl_tpu.ops.ring_attention import reference_attention
 
 
 class RecurrentPPOAgent(nn.Module):
+    """``sequence_model="lstm"`` (default, reference parity) or ``"attention"`` — a
+    causal windowed self-attention sequence mixer in place of the LSTM.  The
+    attention variant is the ``sequence`` mesh axis's training-path consumer: with
+    ``attention_fn`` set (built from ``make_ring_attention``) the training-time
+    attention runs sequence-parallel over the ring; env-side steps carry a rolling
+    window of the last ``attn_window`` inputs (reset at episode starts), which
+    matches the training masks exactly because the loop also resets the window at
+    every rollout start."""
+
     cnn_keys: Sequence[str]
     mlp_keys: Sequence[str]
     action_dims: Sequence[int]
@@ -38,6 +48,10 @@ class RecurrentPPOAgent(nn.Module):
     lstm_hidden_size: int = 64
     pre_rnn_mlp: bool = False
     post_rnn_mlp: bool = False
+    sequence_model: str = "lstm"
+    attn_heads: int = 4
+    attn_window: int = 64
+    attention_fn: Any = None  # static; sequence-parallel ring attention when set
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -59,7 +73,16 @@ class RecurrentPPOAgent(nn.Module):
                 layer_norm=self.layer_norm,
                 dtype=self.dtype,
             )
-        self.cell = nn.OptimizedLSTMCell(self.lstm_hidden_size, dtype=self.dtype)
+        if self.sequence_model == "attention":
+            h = self.lstm_hidden_size  # model width shared with the lstm variant
+            self.attn_in = nn.Dense(h, dtype=self.dtype)
+            self.attn_q = nn.Dense(h, dtype=self.dtype)
+            self.attn_k = nn.Dense(h, dtype=self.dtype)
+            self.attn_v = nn.Dense(h, dtype=self.dtype)
+            self.attn_out = nn.Dense(h, dtype=self.dtype)
+            self.attn_ln = nn.LayerNorm(dtype=self.dtype)
+        else:
+            self.cell = nn.OptimizedLSTMCell(self.lstm_hidden_size, dtype=self.dtype)
         if self.post_rnn_mlp:
             self.post_mlp = MLP(
                 hidden_sizes=(self.dense_units,),
@@ -99,6 +122,10 @@ class RecurrentPPOAgent(nn.Module):
             x = self.pre_mlp(x)
         return x
 
+    def _split_heads(self, x: jax.Array) -> jax.Array:
+        *lead, h = x.shape
+        return x.reshape(*lead, self.attn_heads, h // self.attn_heads)
+
     def step(
         self,
         obs: Dict[str, jax.Array],  # [B, ...]
@@ -107,10 +134,30 @@ class RecurrentPPOAgent(nn.Module):
         state: Tuple[jax.Array, jax.Array],
     ):
         """Single env-side step: returns (actor_out, value, new_state)."""
+        x = self._rnn_input(obs, (1 - is_first) * prev_actions)
+        if self.sequence_model == "attention":
+            window, valid = state  # [B, W, H], [B, W]
+            xp = self.attn_in(x)
+            # Episode start: forget the previous episode's window.
+            window = (1 - is_first[..., None]) * window
+            valid = (1 - is_first) * valid
+            window = jnp.concatenate([window[:, 1:], xp[:, None].astype(window.dtype)], 1)
+            valid = jnp.concatenate([valid[:, 1:], jnp.ones_like(valid[:, :1])], 1)
+            q = self._split_heads(self.attn_q(xp))[:, None]  # [B, 1, nh, hd]
+            k = self._split_heads(self.attn_k(window.astype(xp.dtype)))
+            v = self._split_heads(self.attn_v(window.astype(xp.dtype)))
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+            s = s / jnp.sqrt(jnp.asarray(k.shape[-1], jnp.float32))
+            s = jnp.where(valid[:, None, None, :] > 0, s, jnp.finfo(jnp.float32).min)
+            p = jax.nn.softmax(s, -1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+            o = o.reshape(xp.shape[0], -1).astype(xp.dtype)
+            out = self.attn_ln(xp + self.attn_out(o))
+            actor_out, value = self._heads(out.astype(jnp.float32))
+            return actor_out, value, (window, valid)
         c, h = state
         c = (1 - is_first) * c
         h = (1 - is_first) * h
-        x = self._rnn_input(obs, (1 - is_first) * prev_actions)
         (c, h), out = self.cell((c, h), x)
         actor_out, value = self._heads(out.astype(jnp.float32))
         return actor_out, value, (c, h)
@@ -124,6 +171,29 @@ class RecurrentPPOAgent(nn.Module):
     ):
         """Sequence forward with in-scan resets; returns (actor_out [T,B,...], values)."""
         xs = self._rnn_input(obs, prev_actions * (1 - is_first))
+
+        if self.sequence_model == "attention":
+            # Causal windowed attention over the rollout, masked at episode
+            # boundaries (segments = running count of is_first).  ``initial_state``
+            # is unused: the loop resets the acting window at every rollout start,
+            # so training and acting see identical contexts.
+            T, B = xs.shape[:2]
+            xp = self.attn_in(xs)  # [T, B, H]
+            xbt = jnp.swapaxes(xp, 0, 1)  # [B, T, H]
+            q = self._split_heads(self.attn_q(xbt))
+            k = self._split_heads(self.attn_k(xbt))
+            v = self._split_heads(self.attn_v(xbt))
+            segs = jnp.swapaxes(jnp.cumsum(is_first[..., 0], axis=0), 0, 1).astype(jnp.int32)
+            if self.attention_fn is not None:  # sequence-parallel ring
+                o = self.attention_fn(q, k, v, segs)
+            else:
+                o = reference_attention(
+                    q, k, v, causal=True, segment_ids=segs, window=self.attn_window
+                )
+            o = jnp.swapaxes(o.reshape(B, T, -1), 0, 1).astype(xp.dtype)  # [T, B, H]
+            outs = self.attn_ln(xp + self.attn_out(o))
+            actor_out, values = self._heads(outs.astype(jnp.float32))
+            return actor_out, values
 
         def scan_step(carry, t):
             c, h = carry
@@ -142,8 +212,34 @@ class RecurrentPPOAgent(nn.Module):
         return actor_out, values
 
 
+def make_zero_state(cfg):
+    """Per-env zero carry matching ``algo.sequence_model``: LSTM ``(c, h)`` or the
+    attention variant's ``(window, valid)`` rolling context."""
+    h = cfg.algo.rnn.lstm.hidden_size
+    if cfg.algo.get("sequence_model", "lstm") == "attention":
+        w = int(cfg.algo.attention.window)
+
+        def zero_state(n: int):
+            return (jnp.zeros((n, w, h)), jnp.zeros((n, w)))
+
+    else:
+
+        def zero_state(n: int):
+            return (jnp.zeros((n, h)), jnp.zeros((n, h)))
+
+    return zero_state
+
+
 def build_agent(ctx, action_space, obs_space, cfg) -> Tuple[RecurrentPPOAgent, Any]:
     is_continuous, dims = parse_action_space(action_space)
+    sequence_model = cfg.algo.get("sequence_model", "lstm")
+    attention_fn = None
+    if sequence_model == "attention" and ctx.mesh.shape.get("sequence", 1) > 1:
+        from sheeprl_tpu.ops.ring_attention import make_ring_attention
+
+        attention_fn = make_ring_attention(
+            ctx.mesh, causal=True, window=int(cfg.algo.attention.window)
+        )
     agent = RecurrentPPOAgent(
         cnn_keys=list(cfg.algo.cnn_keys.encoder),
         mlp_keys=list(cfg.algo.mlp_keys.encoder),
@@ -159,6 +255,10 @@ def build_agent(ctx, action_space, obs_space, cfg) -> Tuple[RecurrentPPOAgent, A
         lstm_hidden_size=cfg.algo.rnn.lstm.hidden_size,
         pre_rnn_mlp=cfg.algo.rnn.pre_rnn_mlp.apply,
         post_rnn_mlp=cfg.algo.rnn.post_rnn_mlp.apply,
+        sequence_model=sequence_model,
+        attn_heads=int(cfg.algo.get("attention", {}).get("num_heads", 4)),
+        attn_window=int(cfg.algo.get("attention", {}).get("window", 64)),
+        attention_fn=attention_fn,
         dtype=ctx.compute_dtype,
     )
     dummy_obs = {}
@@ -166,8 +266,7 @@ def build_agent(ctx, action_space, obs_space, cfg) -> Tuple[RecurrentPPOAgent, A
         space = obs_space[k]
         dummy_obs[k] = jnp.zeros((1, *space.shape), dtype=space.dtype)
     act_sum = int(sum(dims))
-    h = cfg.algo.rnn.lstm.hidden_size
-    state0 = (jnp.zeros((1, h)), jnp.zeros((1, h)))
+    state0 = make_zero_state(cfg)(1)
     params = agent.init(
         ctx.rng(), dummy_obs, jnp.zeros((1, act_sum)), jnp.ones((1, 1)), state0, method=RecurrentPPOAgent.step
     )

@@ -20,7 +20,7 @@ import optax
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, log_prob_and_entropy, prepare_obs, sample_actions
-from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, build_agent
+from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, build_agent, make_zero_state
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -165,12 +165,19 @@ def main(ctx, cfg) -> None:
         last_checkpoint = state.get("last_checkpoint", 0)
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
-    lstm_state = (jnp.zeros((num_envs, hidden)), jnp.zeros((num_envs, hidden)))
+    zero_state = make_zero_state(cfg)
+    is_attention = cfg.algo.get("sequence_model", "lstm") == "attention"
+    lstm_state = zero_state(num_envs)
     prev_stored = np.zeros((num_envs, act_sum), dtype=np.float32)
     is_first_np = np.ones((num_envs, 1), dtype=np.float32)
     step_data: Dict[str, np.ndarray] = {}
 
     for update in range(start_update, num_updates + 1):
+        if is_attention:
+            # The attention context never crosses a rollout boundary: training
+            # attends within the rollout only, so acting resets its window here —
+            # the policies stay EXACTLY on-policy.
+            lstm_state = zero_state(num_envs)
         c0, h0 = lstm_state
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
@@ -199,13 +206,16 @@ def main(ctx, cfg) -> None:
                         k: np.stack([np.asarray(info["final_obs"][i][k]) for i in trunc_idx]) for k in obs_keys
                     }
                     sub_state = (lstm_state[0][trunc_idx], lstm_state[1][trunc_idx])
+                    # local_rng: acting-side keys are per-process; drawing from the
+                    # process-identical chain here would desynchronize it across
+                    # ranks (truncations happen at different iterations per rank).
                     _, _, v_final, _ = act_fn(
                         params,
                         prepare_obs(final_obs, cnn_keys, mlp_keys),
                         jnp.asarray(prev_stored[trunc_idx]),
                         jnp.zeros((len(trunc_idx), 1)),
                         sub_state,
-                        ctx.rng(),
+                        ctx.local_rng(),
                     )
                     reward[trunc_idx] += gamma * np.asarray(jax.device_get(v_final))
 
@@ -307,7 +317,6 @@ def test(agent, params, ctx, cfg, log_dir: str, greedy: bool = True) -> float:
     env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
-    hidden = cfg.algo.rnn.lstm.hidden_size
     act_sum = int(sum(agent.action_dims))
 
     @jax.jit
@@ -317,7 +326,7 @@ def test(agent, params, ctx, cfg, log_dir: str, greedy: bool = True) -> float:
         return env_act, new_state
 
     obs, _ = env.reset(seed=cfg.seed)
-    state = (jnp.zeros((1, hidden)), jnp.zeros((1, hidden)))
+    state = make_zero_state(cfg)(1)
     prev = np.zeros((1, act_sum), dtype=np.float32)
     is_first = np.ones((1, 1), dtype=np.float32)
     done, cum_reward = False, 0.0

@@ -9,6 +9,7 @@ scan.  The replay-ratio ``Ratio`` governor decides G exactly as in the reference
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from pathlib import Path
@@ -26,6 +27,8 @@ from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -211,6 +214,45 @@ def main(ctx, cfg) -> None:
     obs, _ = envs.reset(seed=cfg.seed + rank)
     step_data: Dict[str, np.ndarray] = {}
 
+    # Async host-side sampling (SURVEY §7): the worker draws + ships the next [G, B]
+    # block while the device executes the current one; ``rb.add`` holds the sampler's
+    # lock so the worker never reads a row mid-write.  ``next_{k}`` keys are stored
+    # explicitly (with final-obs correction), so no derived next-obs sampling is
+    # needed.  Batch axis 1 of the [G, B, ...] block is sharded over the data axis —
+    # GSPMD inserts the gradient all-reduce (params stay replicated).
+    def _sample_block(n: int):
+        sample = rb.sample(batch_size * n)
+        batches = {
+            "obs": np.concatenate([sample[k].reshape(n, batch_size, -1) for k in mlp_keys], -1),
+            "next_obs": np.concatenate(
+                [sample[f"next_{k}"].reshape(n, batch_size, -1) for k in mlp_keys], -1
+            ),
+            "actions": sample["actions"].reshape(n, batch_size, -1),
+            "rewards": sample["rewards"].reshape(n, batch_size, 1),
+            "dones": sample["dones"].reshape(n, batch_size, 1),
+        }
+        return ctx.put_batch(batches, batch_axis=1)
+
+    if cfg.algo.get("async_prefetch", True):
+        prefetcher = AsyncBatchPrefetcher(_sample_block)
+        rb_lock = prefetcher.lock
+    else:
+        prefetcher, rb_lock = None, contextlib.nullcontext()
+    futures = WindowedFutures()
+
+    def _dispatch_train(grad_steps: int, stage_next: bool) -> None:
+        nonlocal params, opt_state, cumulative_grad_steps
+        batches = (
+            prefetcher.get(grad_steps, stage_next=stage_next)
+            if prefetcher is not None
+            else _sample_block(grad_steps)
+        )
+        params, opt_state, train_metrics = train_fn(
+            params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+        )
+        futures.track(train_metrics, grad_steps)
+        cumulative_grad_steps += grad_steps
+
     for iter_num in range(start_iter, num_iters + 1):
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
@@ -227,6 +269,29 @@ def main(ctx, cfg) -> None:
                 actions = (
                     act_low + (tanh_actions + 1) * 0.5 * (act_high - act_low) if rescale else tanh_actions
                 )
+        env_time = time.perf_counter() - env_t0
+
+        # Dispatch this iteration's gradient block BEFORE stepping the envs so the
+        # device trains while the host walks the environments (acting above used the
+        # previous iteration's params, as before).  SAC rows are committed only
+        # after env.step (they carry next_obs), so the very first training
+        # iteration — empty buffer — defers its dispatch until after the row lands.
+        grad_steps = 0
+        deferred_dispatch = False
+        if iter_num >= learning_starts:
+            # Offset by the prefill so the governor doesn't demand the whole
+            # prefill's worth of gradient steps in one burst (reference sac.py:301).
+            grad_steps = ratio(
+                (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
+            )
+            if grad_steps > 0:
+                if rb.empty:
+                    deferred_dispatch = True
+                else:
+                    _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
+
+        env_t0 = time.perf_counter()
+        with timer("Time/env_interaction_time"):
             next_obs, reward, terminated, truncated, info = envs.step(actions)
             done = np.logical_or(terminated, truncated)
 
@@ -246,53 +311,24 @@ def main(ctx, cfg) -> None:
             step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
             # Truncated episodes still bootstrap (done=0 in the TD target).
             step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            with rb_lock:
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
             obs = next_obs
             policy_step += policy_steps_per_iter
             record_episode_stats(aggregator, info)
-        env_time = time.perf_counter() - env_t0
+        env_time += time.perf_counter() - env_t0
 
-        train_time = 0.0
-        grad_steps = 0
-        if iter_num >= learning_starts:
-            # Offset by the prefill so the governor doesn't demand the whole
-            # prefill's worth of gradient steps in one burst (reference sac.py:301).
-            grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
-            if grad_steps > 0:
-                # next_{k} keys are stored explicitly (with final-obs correction), so no
-                # derived next-obs sampling is needed.
-                sample = rb.sample(batch_size * grad_steps)
-                batches = {
-                    "obs": np.concatenate(
-                        [sample[k].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1
-                    ),
-                    "next_obs": np.concatenate(
-                        [sample[f"next_{k}"].reshape(grad_steps, batch_size, -1) for k in mlp_keys], -1
-                    ),
-                    "actions": sample["actions"].reshape(grad_steps, batch_size, -1),
-                    "rewards": sample["rewards"].reshape(grad_steps, batch_size, 1),
-                    "dones": sample["dones"].reshape(grad_steps, batch_size, 1),
-                }
-                # Batch axis 1 of the [G, B, ...] block sharded over the data axis —
-                # GSPMD inserts the gradient all-reduce (params stay replicated).
-                batches = ctx.put_batch(batches, batch_axis=1)
-                with timer("Time/train_time"):
-                    t0 = time.perf_counter()
-                    params, opt_state, train_metrics = train_fn(
-                        params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
-                    )
-                    train_metrics = jax.device_get(train_metrics)
-                    train_time = time.perf_counter() - t0
-                cumulative_grad_steps += grad_steps
-                for k, v in train_metrics.items():
-                    aggregator.update(k, float(v))
+        if deferred_dispatch:
+            _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
 
         if logger is not None and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
         ):
+            futures.drain(aggregator)  # the window's only blocking device sync
             metrics = aggregator.compute()
-            if train_time > 0:
-                metrics["Time/sps_train"] = grad_steps / train_time
+            window_sps = futures.pop_window_sps()
+            if window_sps is not None:
+                metrics["Time/sps_train"] = window_sps
             metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
             metrics["Params/replay_ratio"] = (
                 cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
@@ -323,6 +359,8 @@ def main(ctx, cfg) -> None:
             last_checkpoint = policy_step
 
     envs.close()
+    if prefetcher is not None:
+        prefetcher.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(actor, params, ctx, cfg, log_dir)
         if logger is not None:

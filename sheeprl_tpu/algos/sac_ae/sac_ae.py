@@ -10,6 +10,7 @@ frequency conditionals."""
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from pathlib import Path
@@ -26,6 +27,8 @@ from sheeprl_tpu.algos.sac_ae.agent import build_agent, preprocess_obs
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -261,6 +264,50 @@ def main(ctx, cfg) -> None:
     obs, _ = envs.reset(seed=cfg.seed + rank)
     step_data: Dict[str, np.ndarray] = {}
 
+    # Async host-side sampling + deferred metrics (see sac.py / utils/blocks.py).
+    def _sample_block(n: int):
+        sample = rb.sample(batch_size * n)
+
+        def cat_imgs(prefix=""):
+            return np.concatenate(
+                [
+                    sample[f"{prefix}{k}"].reshape(n, batch_size, -1, *sample[f"{prefix}{k}"].shape[-2:])
+                    for k in cnn_keys
+                ],
+                axis=2,
+            )
+
+        return ctx.put_batch(
+            {
+                "obs": cat_imgs(),
+                "next_obs": cat_imgs("next_"),
+                "actions": sample["actions"].reshape(n, batch_size, -1),
+                "rewards": sample["rewards"].reshape(n, batch_size, 1),
+                "dones": sample["dones"].reshape(n, batch_size, 1),
+            },
+            batch_axis=1,
+        )
+
+    if cfg.algo.get("async_prefetch", True):
+        prefetcher = AsyncBatchPrefetcher(_sample_block)
+        rb_lock = prefetcher.lock
+    else:
+        prefetcher, rb_lock = None, contextlib.nullcontext()
+    futures = WindowedFutures()
+
+    def _dispatch_train(grad_steps: int, stage_next: bool) -> None:
+        nonlocal params, opt_state, cumulative_grad_steps
+        batches = (
+            prefetcher.get(grad_steps, stage_next=stage_next)
+            if prefetcher is not None
+            else _sample_block(grad_steps)
+        )
+        params, opt_state, train_metrics = train_fn(
+            params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+        )
+        futures.track(train_metrics, grad_steps)
+        cumulative_grad_steps += grad_steps
+
     for iter_num in range(start_iter, num_iters + 1):
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
@@ -271,6 +318,25 @@ def main(ctx, cfg) -> None:
                 img = jnp.asarray(_img(obs) / 255.0)
                 tanh_actions = np.asarray(jax.device_get(act_fn(params, img, ctx.local_rng())))
                 actions = act_low + (tanh_actions + 1) * 0.5 * (act_high - act_low) if rescale else tanh_actions
+        env_time = time.perf_counter() - env_t0
+
+        # Dispatch this iteration's gradient block BEFORE stepping the envs so the
+        # device trains while the host walks the environments; the first training
+        # iteration (empty buffer — rows carry next_obs) defers until the row lands.
+        grad_steps = 0
+        deferred_dispatch = False
+        if iter_num >= learning_starts:
+            grad_steps = ratio(
+                (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
+            )
+            if grad_steps > 0:
+                if rb.empty:
+                    deferred_dispatch = True
+                else:
+                    _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
+
+        env_t0 = time.perf_counter()
+        with timer("Time/env_interaction_time"):
             next_obs, reward, terminated, truncated, info = envs.step(actions)
             done = np.logical_or(terminated, truncated)
             real_next = {k: np.asarray(next_obs[k]).copy() for k in cnn_keys}
@@ -287,55 +353,24 @@ def main(ctx, cfg) -> None:
             step_data["actions"] = tanh_actions.astype(np.float32)[None]
             step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
             step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            with rb_lock:
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
             obs = next_obs
             policy_step += policy_steps_per_iter
             record_episode_stats(aggregator, info)
-        env_time = time.perf_counter() - env_t0
+        env_time += time.perf_counter() - env_t0
 
-        train_time, grad_steps = 0.0, 0
-        if iter_num >= learning_starts:
-            grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
-            if grad_steps > 0:
-                sample = rb.sample(batch_size * grad_steps)
-                g = grad_steps
-
-                def cat_imgs(prefix=""):
-                    return np.concatenate(
-                        [
-                            sample[f"{prefix}{k}"].reshape(g, batch_size, -1, *sample[f"{prefix}{k}"].shape[-2:])
-                            for k in cnn_keys
-                        ],
-                        axis=2,
-                    )
-
-                batches = ctx.put_batch(
-                    {
-                        "obs": cat_imgs(),
-                        "next_obs": cat_imgs("next_"),
-                        "actions": sample["actions"].reshape(g, batch_size, -1),
-                        "rewards": sample["rewards"].reshape(g, batch_size, 1),
-                        "dones": sample["dones"].reshape(g, batch_size, 1),
-                    },
-                    batch_axis=1,
-                )
-                with timer("Time/train_time"):
-                    t0 = time.perf_counter()
-                    params, opt_state, train_metrics = train_fn(
-                        params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
-                    )
-                    train_metrics = jax.device_get(train_metrics)
-                    train_time = time.perf_counter() - t0
-                cumulative_grad_steps += grad_steps
-                for k, v in train_metrics.items():
-                    aggregator.update(k, float(v))
+        if deferred_dispatch:
+            _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
 
         if logger is not None and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
         ):
+            futures.drain(aggregator)  # the window's only blocking device sync
             metrics = aggregator.compute()
-            if train_time > 0:
-                metrics["Time/sps_train"] = grad_steps / train_time
+            window_sps = futures.pop_window_sps()
+            if window_sps is not None:
+                metrics["Time/sps_train"] = window_sps
             metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
             metrics["Params/replay_ratio"] = cumulative_grad_steps * world / policy_step if policy_step else 0.0
             logger.log_metrics(metrics, policy_step)
@@ -364,6 +399,8 @@ def main(ctx, cfg) -> None:
             last_checkpoint = policy_step
 
     envs.close()
+    if prefetcher is not None:
+        prefetcher.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(greedy_fn, params, ctx, cfg, log_dir, _img)
         if logger is not None:

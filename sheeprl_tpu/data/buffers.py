@@ -26,7 +26,7 @@ import shutil
 import typing
 import uuid
 from pathlib import Path
-from typing import Any, Dict, Optional, Sequence, Type
+from typing import Any, Dict, Optional, Sequence, Tuple, Type
 
 import numpy as np
 
@@ -290,6 +290,14 @@ class SequentialReplayBuffer(ReplayBuffer):
         if self._full and sequence_length > len(self):
             raise ValueError(f"Sequence length ({sequence_length}) longer than buffer ({len(self)})")
         batch_dim = batch_size * n_samples
+        starts = self.sample_start_idxes(batch_dim, sequence_length)
+        offsets = np.arange(sequence_length, dtype=np.intp)[None, :]
+        idxes = (starts[:, None] + offsets) % self._buffer_size  # [B*N, T]
+        return self._gather_sequences(idxes, batch_size, n_samples, sequence_length, sample_next_obs, clone)
+
+    def sample_start_idxes(self, batch_dim: int, sequence_length: int) -> np.ndarray:
+        """Uniform valid sequence-start rows (used directly by the device-resident
+        mirror, which gathers on device from these indices)."""
         if self._full:
             # Valid starts are those whose sequence does not cross the write cursor:
             # [0, pos - seq_len] ∪ [pos, end-of-wrappable-range]  (reference ``:439-456``)
@@ -298,12 +306,8 @@ class SequentialReplayBuffer(ReplayBuffer):
             valid = np.concatenate(
                 [np.arange(0, max(first_range_end, 0)), np.arange(self._pos, second_range_end)]
             ).astype(np.intp)
-            starts = valid[self._rng.integers(0, len(valid), size=batch_dim)]
-        else:
-            starts = self._rng.integers(0, self._pos - sequence_length + 1, size=batch_dim)
-        offsets = np.arange(sequence_length, dtype=np.intp)[None, :]
-        idxes = (starts[:, None] + offsets) % self._buffer_size  # [B*N, T]
-        return self._gather_sequences(idxes, batch_size, n_samples, sequence_length, sample_next_obs, clone)
+            return valid[self._rng.integers(0, len(valid), size=batch_dim)]
+        return self._rng.integers(0, self._pos - sequence_length + 1, size=batch_dim)
 
     def _gather_sequences(
         self,
@@ -477,6 +481,20 @@ class EnvIndependentReplayBuffer:
     ) -> Dict[str, "jax.Array"]:
         samples = self.sample(batch_size=batch_size, sample_next_obs=sample_next_obs, n_samples=n_samples, **kwargs)
         return to_device(samples, dtype=dtype, sharding=sharding)
+
+    def sample_idx(self, batch_size: int, sequence_length: int) -> "Tuple[np.ndarray, np.ndarray]":
+        """Index-only sequence sampling for the device-resident mirror
+        (``data/device_buffer.py``): same env-split + start-validity distribution as
+        :meth:`sample`, but returns ``(env_ids [B], starts [B])`` instead of data."""
+        valid = [i for i, b in enumerate(self._buf) if len(b) > 0]
+        if not valid:
+            raise ValueError("No sample has been added to the buffer.")
+        env_ids = np.asarray(valid, np.intp)[self._rng.integers(0, len(valid), size=batch_size)]
+        starts = np.empty(batch_size, np.intp)
+        for i in np.unique(env_ids):
+            sel = env_ids == i
+            starts[sel] = self._buf[i].sample_start_idxes(int(sel.sum()), sequence_length)
+        return env_ids, starts
 
     def state_dict(self) -> Dict[str, Any]:
         return {"buffers": [b.state_dict() for b in self._buf]}

@@ -1,0 +1,122 @@
+"""Device-resident replay mirror: keep the replay data in HBM, ship only indices.
+
+The reference samples on the host and ships every batch to the accelerator
+(``/root/reference/sheeprl/data/buffers.py`` + ``sample_tensors``).  At DreamerV3's
+Atari shapes that is ~12 MB per gradient step of mostly-redundant pixels, and on a
+remote TPU the host→device link (not the MXU) becomes the training bottleneck.
+
+TPU-native answer: the replay rows live ON the device.
+
+* every row appended to the host buffer is also scattered into a ``[capacity,
+  n_envs, ...]`` device ring via a DONATED jitted update (in-place, no copy of the
+  ring) — ~12 KB/env/step uplink instead of ~12 MB/grad-step;
+* sampling draws only (env, start) INDEX pairs on the host (same validity logic as
+  the host buffer) and gathers the ``[T, B]`` batch inside the jitted train block —
+  an HBM gather, three orders of magnitude faster than the tunnel;
+* the host buffer stays the source of truth for checkpoint/resume; ``load_from``
+  rebuilds the mirror after a resume.
+
+The mirror requires the whole buffer to fit in HBM next to the model: ~1.2 GB for
+the 100K-transition Atari-100K config — comfortable on any current TPU.  Enabled by
+``buffer.device: True`` (the flagship default); loops fall back to host sampling +
+prefetch when disabled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows_tree(
+    bufs: Dict[str, jax.Array], rows: Dict[str, jax.Array], envs: jax.Array, positions: jax.Array
+) -> Dict[str, jax.Array]:
+    """In-place ``bufs[k][positions[i], envs[i]] = rows[k][i]`` for every key in ONE
+    dispatch (donated — no ring copy; per-key calls would each pay the dispatch
+    overhead that dominates remote-TPU hosts)."""
+    return {k: bufs[k].at[positions, envs].set(rows[k]) for k in bufs}
+
+
+def gather_sequences(
+    mirror: Dict[str, jax.Array], envs: jax.Array, starts: jax.Array, sequence_length: int
+) -> Dict[str, jax.Array]:
+    """In-jit gather of ``[T, B, ...]`` sequences from ``[cap, n_envs, ...]`` rings.
+
+    ``envs``/``starts``: ``[B]`` int32; rows wrap modulo capacity (the host-side
+    index sampling guarantees wrapped sequences never cross the write cursor).
+    """
+    out = {}
+    for k, buf in mirror.items():
+        cap = buf.shape[0]
+        t_idx = (starts[:, None] + jnp.arange(sequence_length, dtype=starts.dtype)) % cap  # [B, T]
+        picked = buf[t_idx, envs[:, None]]  # [B, T, ...]
+        out[k] = jnp.swapaxes(picked, 0, 1)  # [T, B, ...]
+    return out
+
+
+class DeviceReplayMirror:
+    """Device ring mirroring an ``EnvIndependentReplayBuffer``'s rows.
+
+    ``specs``: ``{key: (shape, dtype)}`` per-row (no leading axes).  All write
+    positions are tracked by the caller (the host buffer's per-env cursors).
+    """
+
+    def __init__(self, capacity: int, n_envs: int, specs: Dict[str, Tuple[Sequence[int], Any]]):
+        self.capacity = int(capacity)
+        self.n_envs = int(n_envs)
+        self.specs = dict(specs)
+        self.arrays: Dict[str, jax.Array] = {
+            k: jnp.zeros((self.capacity, self.n_envs, *shape), dtype) for k, (shape, dtype) in specs.items()
+        }
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in self.arrays.values())
+
+    def add(self, data: Dict[str, np.ndarray], envs: Sequence[int], positions: Sequence[int]) -> None:
+        """Scatter one row per selected env: ``data[k]`` is ``[1, len(envs), ...]``
+        (the loops' step_data layout); ``positions[i]`` is env ``envs[i]``'s write
+        cursor BEFORE the host add.  Static shapes: pad to ``n_envs`` by repeating
+        the first target (idempotent duplicate write)."""
+        n = len(envs)
+        pad = self.n_envs - n
+        env_arr = np.asarray(list(envs) + [envs[0]] * pad, np.int32)
+        pos_arr = np.asarray([p % self.capacity for p in positions] + [positions[0] % self.capacity] * pad, np.int32)
+        row_tree = {}
+        for k in self.arrays:
+            rows = np.asarray(data[k])[0]  # [n, ...]
+            if pad:
+                rows = np.concatenate([rows, np.repeat(rows[:1], pad, axis=0)], 0)
+            row_tree[k] = rows.reshape(self.n_envs, *self.specs[k][0]).astype(self.specs[k][1])
+        self.arrays = _scatter_rows_tree(self.arrays, row_tree, env_arr, pos_arr)
+
+    def load_from(self, host_rb) -> None:
+        """Rebuild the mirror from an ``EnvIndependentReplayBuffer`` (resume path):
+        one bulk transfer per key."""
+        for k in self.arrays:
+            host = np.zeros(self.arrays[k].shape, self.specs[k][1])
+            for e, sub in enumerate(host_rb.buffer):
+                arr = np.asarray(sub._buf[k])  # [cap, 1, ...]
+                rows = min(arr.shape[0], self.capacity)
+                host[:rows, e] = arr[:rows, 0].reshape(rows, *self.specs[k][0])
+            self.arrays[k] = jax.device_put(host)
+
+
+def make_mirror_for(rb, cnn_keys, mlp_keys, obs_space, extra_float_keys) -> DeviceReplayMirror:
+    """Build a mirror matching the Dreamer loops' row layout (``_obs_row``): pixel
+    keys are stored ``[C_total, H, W]`` uint8 (decoded to float on device inside
+    the train step), vector keys flat float32, scalar keys float32 ``[dim]``."""
+    specs: Dict[str, Tuple[Sequence[int], Any]] = {}
+    for k in cnn_keys:
+        shape = obs_space[k].shape
+        specs[k] = ((int(np.prod(shape[:-2])), *shape[-2:]), jnp.uint8)
+    for k in mlp_keys:
+        specs[k] = ((int(np.prod(obs_space[k].shape)),), jnp.float32)
+    for k, dim in extra_float_keys:
+        specs[k] = ((int(dim),), jnp.float32)
+    return DeviceReplayMirror(rb.buffer_size, rb.n_envs, specs)

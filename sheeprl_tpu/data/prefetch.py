@@ -25,9 +25,14 @@ from typing import Any, Callable, Optional
 
 
 class AsyncBatchPrefetcher:
-    def __init__(self, sample_fn: Callable[[int], Any]):
+    def __init__(self, sample_fn: Callable[[int], Any], slice_fn: Optional[Callable[[Any, int], Any]] = None):
         self.lock = threading.Lock()
         self._sample_fn = sample_fn
+        # How to cut a staged block down to n steps (for an oscillating Ratio).
+        # Default: list prefix / leading-axis slice of every leaf.  Loops whose block
+        # mixes per-step and per-block parts (e.g. DroQ's critic block + one actor
+        # batch) pass their own.
+        self._slice_fn = slice_fn
         self._req: "queue.Queue[Optional[int]]" = queue.Queue(maxsize=1)
         self._res: "queue.Queue[Any]" = queue.Queue(maxsize=1)
         self._pending_n: Optional[int] = None
@@ -60,7 +65,9 @@ class AsyncBatchPrefetcher:
             if staged_n > n:
                 # Oscillating Ratio (e.g. 1,2,1,2,...): reuse the staged block's
                 # first n samples instead of discarding the whole transfer.
-                if isinstance(block, list):
+                if self._slice_fn is not None:
+                    block = self._slice_fn(block, n)
+                elif isinstance(block, list):
                     block = block[:n]
                 else:
                     import jax

@@ -64,6 +64,80 @@ class _DummyEnv(gym.Env):
         pass
 
 
+class LineWalkDummyEnv(gym.Env):
+    """A tiny solvable MDP for learning tests (no reference counterpart; VERDICT r2
+    items 1/5): the agent walks on a line of ``length`` cells and is paid +1 for every
+    step it spends on the rightmost cell.
+
+    * actions: ``Discrete(3)`` — 0 stay, 1 left, 2 right;
+    * obs: ``{rgb, state}`` — ``state`` is the one-hot position, ``rgb`` renders the
+      position as a white vertical bar on black, so the reward is a function of the
+      VISIBLE state only.  A pixels-only agent (``cnn_keys=[rgb]``) can therefore
+      improve its return only if the whole pixels → world model → imagination →
+      policy loop works;
+    * known returns over ``n_steps=16``, ``length=6``: optimal ≈ ``n_steps - length + 1``
+      (walk right, then stay), random walk ≲ 1.5.
+
+    Episode ends by TRUNCATION at ``n_steps`` (the step counter is not observable, so
+    a termination there would be unlearnable for the continue model).
+    """
+
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+
+    def __init__(
+        self,
+        length: int = 6,
+        n_steps: int = 16,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+    ):
+        self._length = length
+        self._n_steps = n_steps
+        self._image_size = image_size
+        self.action_space = gym.spaces.Discrete(3)
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
+                "state": gym.spaces.Box(0.0, 1.0, shape=(length,), dtype=np.float32),
+            }
+        )
+        self.reward_range = (0.0, 1.0)
+        self._pos = 0
+        self._current_step = 0
+
+    def _get_obs(self):
+        c, h, w = self._image_size
+        rgb = np.zeros((c, h, w), dtype=np.uint8)
+        band = max(w // self._length, 1)
+        start = self._pos * band
+        rgb[:, :, start : start + band] = 255
+        state = np.zeros((self._length,), dtype=np.float32)
+        state[self._pos] = 1.0
+        return {"rgb": rgb, "state": state}
+
+    def step(self, action):
+        action = int(np.asarray(action).reshape(-1)[0])
+        if action == 1:
+            self._pos = max(self._pos - 1, 0)
+        elif action == 2:
+            self._pos = min(self._pos + 1, self._length - 1)
+        reward = 1.0 if self._pos == self._length - 1 else 0.0
+        self._current_step += 1
+        truncated = self._current_step >= self._n_steps
+        return self._get_obs(), reward, False, truncated, {}
+
+    def reset(self, seed: Optional[int] = None, options=None):
+        super().reset(seed=seed)
+        self._pos = 0
+        self._current_step = 0
+        return self._get_obs(), {}
+
+    def render(self):
+        return np.transpose(self._get_obs()["rgb"], (1, 2, 0))
+
+    def close(self):
+        pass
+
+
 class ContinuousDummyEnv(_DummyEnv):
     def __init__(
         self,

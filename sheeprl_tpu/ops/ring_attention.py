@@ -24,21 +24,37 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _attn_block(q, k_blk, v_blk, acc, m, l, scale, q_pos, kv_pos, causal):
+def _block_mask(q_pos, kv_pos, causal, q_seg=None, kv_seg=None, window=None):
+    """[B?, Tq, Tk] boolean mask combining causality, segment equality (episode
+    boundaries) and a sliding attention window; None when nothing masks."""
+    mask = None
+    if causal:
+        mask = kv_pos[None, :] <= q_pos[:, None]  # [Tq, Tk]
+    if window is not None:
+        w = (q_pos[:, None] - kv_pos[None, :]) < window
+        mask = w if mask is None else (mask & w)
+    if mask is not None:
+        mask = mask[None]  # broadcast over batch
+    if q_seg is not None:
+        seg = q_seg[:, :, None] == kv_seg[:, None, :]  # [B, Tq, Tk]
+        mask = seg if mask is None else (mask & seg)
+    return mask
+
+
+def _attn_block(q, k_blk, v_blk, acc, m, l, scale, mask):
     """One flash-attention accumulation step against a single kv block.
 
     ``acc``: [B, H, Tq, D] un-normalised output; ``m``: [B, H, Tq] running max;
-    ``l``: [B, H, Tq] running denominator."""
+    ``l``: [B, H, Tq] running denominator; ``mask``: [B|1, Tq, Tk] or None."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale  # [B, H, Tq, Tk]
-    if causal:
-        mask = kv_pos[None, None, None, :] <= q_pos[None, None, :, None]
-        s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+    if mask is not None:
+        s = jnp.where(mask[:, None], s, jnp.finfo(s.dtype).min)
     m_new = jnp.maximum(m, s.max(-1))
     p = jnp.exp(s - m_new[..., None])
-    if causal:
+    if mask is not None:
         # re-mask: a fully-masked row has s == m_new == finfo.min everywhere, so the
         # exp above would contribute p = 1 per masked entry without this zeroing
-        p = jnp.where(mask, p, 0.0)
+        p = jnp.where(mask[:, None], p, 0.0)
     corr = jnp.exp(m - m_new)
     l = l * corr + p.sum(-1)
     acc = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
@@ -49,14 +65,19 @@ def ring_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    segment_ids: jax.Array = None,
     axis_name: str = "sequence",
     causal: bool = False,
+    window: int = None,
 ) -> jax.Array:
     """Per-device ring attention body (call inside ``shard_map``).
 
     ``q, k, v``: the LOCAL ``[B, T_local, H, D]`` blocks of a global ``[B, T, H, D]``
-    sequence sharded over ``axis_name``.  Returns the local ``[B, T_local, H, D]``
-    output of exact (optionally causal) attention over the full sequence.
+    sequence sharded over ``axis_name``; ``segment_ids``: optional local ``[B,
+    T_local]`` int segments (attention never crosses a segment boundary — episode
+    masking); ``window``: optional sliding-window size (a query attends to at most
+    the last ``window`` positions).  Returns the local ``[B, T_local, H, D]`` output
+    of exact attention over the full sequence under those masks.
     """
     ring = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -71,45 +92,64 @@ def ring_attention(
     qf, kf, vf = q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
     perm = [(i, (i + 1) % ring) for i in range(ring)]
     k_blk, v_blk = kf, vf
+    kv_seg = segment_ids
     for r in range(ring):
         src = (my_idx - r) % ring  # which device's kv block we currently hold
         kv_pos = src * T_local + jnp.arange(T_local)
-        acc, m, l = _attn_block(qf, k_blk, v_blk, acc, m, l, scale, q_pos, kv_pos, causal)
+        mask = _block_mask(q_pos, kv_pos, causal, segment_ids, kv_seg, window)
+        acc, m, l = _attn_block(qf, k_blk, v_blk, acc, m, l, scale, mask)
         if r + 1 < ring:
-            # rotate kv around the ring; overlaps with the next block's compute
+            # rotate kv (and its segments) around the ring; overlaps with the next
+            # block's compute
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            if kv_seg is not None:
+                kv_seg = jax.lax.ppermute(kv_seg, axis_name, perm)
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, Tq, D]
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, axis_name: str = "sequence", causal: bool = False):
+def make_ring_attention(
+    mesh: Mesh, axis_name: str = "sequence", causal: bool = False, window: int = None
+):
     """Wrap ``ring_attention`` in ``shard_map`` for ``[B, T, H, D]`` inputs sharded
-    over ``axis_name`` on ``mesh`` (time axis 1)."""
+    over ``axis_name`` on ``mesh`` (time axis 1); optional ``[B, T]``
+    ``segment_ids``."""
     spec = P(None, axis_name)
-    fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-    )
+    body = functools.partial(ring_attention, axis_name=axis_name, causal=causal, window=window)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    fn_seg = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, spec), out_specs=spec)
 
-    def apply(q, k, v):
+    def apply(q, k, v, segment_ids=None):
         sharding = NamedSharding(mesh, spec)
-        return fn(jax.device_put(q, sharding), jax.device_put(k, sharding), jax.device_put(v, sharding))
+        q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+        if segment_ids is None:
+            return fn(q, k, v)
+        return fn_seg(q, k, v, jax.device_put(segment_ids, sharding))
 
     return apply
 
 
-def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False) -> jax.Array:
-    """Plain full-materialisation attention for parity checks."""
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    segment_ids: jax.Array = None,
+    window: int = None,
+) -> jax.Array:
+    """Plain full-materialisation attention (same masks as ``ring_attention``) —
+    the single-device path and the parity oracle for the ring."""
     B, T, H, D = q.shape
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
     s = s / jnp.sqrt(jnp.asarray(D, jnp.float32))
-    if causal:
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, jnp.finfo(jnp.float32).min)
+    pos = jnp.arange(T)
+    mask = _block_mask(pos, pos, causal, segment_ids, segment_ids, window)
+    if mask is not None:
+        s = jnp.where(mask[:, None], s, jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(s, -1)
+    if mask is not None:
+        p = jnp.where(mask[:, None], p, 0.0)  # fully-masked rows attend to nothing
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)

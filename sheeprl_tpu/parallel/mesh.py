@@ -188,30 +188,45 @@ class MeshContext:
         return jax.tree.map(_put, tree)
 
     # -- rng ----------------------------------------------------------------
+    # Keys are drawn in batches of _RNG_BATCH: jax.random.split is an eager device
+    # op, and on a remote accelerator one dispatch per key would cost a round trip
+    # per training-loop iteration.  Amortised, the chain stays deterministic:
+    # refill r of a chain yields keys split(chain_r)[1:], chain_{r+1}=split(chain_r)[0].
+    _RNG_BATCH = 64
+
+    def _draw(self, chain_attr: str, buf_attr: str, seed_fn) -> jax.Array:
+        buf = getattr(self, buf_attr, None)
+        if not buf:
+            chain = getattr(self, chain_attr)
+            if chain is None:
+                chain = seed_fn()
+            keys = jax.random.split(chain, self._RNG_BATCH + 1)
+            setattr(self, chain_attr, keys[0])
+            buf = [keys[i] for i in range(self._RNG_BATCH, 0, -1)]  # pop() keeps order
+        sub = buf.pop()
+        setattr(self, buf_attr, buf)
+        return sub
+
     def rng(self) -> jax.Array:
-        """Split a fresh key off the PROCESS-IDENTICAL chain (seeded with ``seed``
+        """Draw a fresh key off the PROCESS-IDENTICAL chain (seeded with ``seed``
         alone).  Use for parameter initialisation and jitted train-step keys: with
         replicated params, every process must feed the SPMD program the same
         replicated inputs, or the replicas diverge (and ``device_put`` with a
         replicated sharding asserts on the mismatch)."""
-        if self._rng_key is None:
-            self._rng_key = jax.random.PRNGKey(self.seed)
-        self._rng_key, sub = jax.random.split(self._rng_key)
-        return sub
+        return self._draw("_rng_key", "_rng_buf", lambda: jax.random.PRNGKey(self.seed))
 
     def local_rng(self) -> jax.Array:
-        """Split a fresh key off the PER-PROCESS chain (``seed + process_index``).
+        """Draw a fresh key off the PER-PROCESS chain (``seed + process_index``).
         Use for env-side action sampling and anything that should explore
         differently on each rank (the analogue of the reference's per-rank torch
         seeding)."""
-        if self._local_rng_key is None:
-            # fold_in decorrelates this chain from the shared one even on process 0
-            # (a bare ``seed + process_index`` would alias the shared chain there).
-            self._local_rng_key = jax.random.fold_in(
-                jax.random.PRNGKey(self.seed), 0x5EED + jax.process_index()
-            )
-        self._local_rng_key, sub = jax.random.split(self._local_rng_key)
-        return sub
+        # fold_in decorrelates this chain from the shared one even on process 0
+        # (a bare ``seed + process_index`` would alias the shared chain there).
+        return self._draw(
+            "_local_rng_key",
+            "_local_rng_buf",
+            lambda: jax.random.fold_in(jax.random.PRNGKey(self.seed), 0x5EED + jax.process_index()),
+        )
 
     # -- host-object exchange (reference: TorchCollective over gloo) --------
     def broadcast_obj(self, obj: Any) -> Any:

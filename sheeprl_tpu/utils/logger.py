@@ -79,7 +79,82 @@ class TensorBoardLogger:
             self._writer.close()
 
 
+class MlflowLogger:
+    """MLflow experiment-tracking logger (reference ``utils/logger.py:12-36`` +
+    ``configs/logger/mlflow.yaml:1``), sharing the run-dir contract with the TB
+    logger: the versioned ``log_dir`` still holds config.yaml/checkpoints; metrics
+    additionally stream to the MLflow tracking server.  Rank-0 only, like the
+    reference's rank-zero-experiment guard."""
+
+    def __init__(
+        self,
+        log_dir: str,
+        tracking_uri: Optional[str] = None,
+        experiment_name: Optional[str] = None,
+        run_name: Optional[str] = None,
+        run_id: Optional[str] = None,
+    ):
+        self.log_dir = log_dir
+        self._run = None
+        if jax.process_index() != 0:
+            return
+        import mlflow  # guarded by get_logger
+
+        self._mlflow = mlflow
+        if tracking_uri or os.environ.get("MLFLOW_TRACKING_URI"):
+            mlflow.set_tracking_uri(tracking_uri or os.environ["MLFLOW_TRACKING_URI"])
+        if experiment_name:
+            mlflow.set_experiment(experiment_name)
+        self._run = mlflow.start_run(run_id=run_id, run_name=run_name)
+        self.run_id = self._run.info.run_id
+
+    def log_metrics(self, metrics: Dict[str, float], step: int) -> None:
+        if self._run is None:
+            return
+        self._mlflow.log_metrics({k: float(v) for k, v in metrics.items()}, step=int(step))
+
+    def log_hyperparams(self, cfg: Dict[str, Any]) -> None:
+        if self._run is None:
+            return
+
+        def _flatten(d, prefix=""):
+            out = {}
+            for k, v in d.items():
+                key = f"{prefix}{k}"
+                if isinstance(v, dict):
+                    out.update(_flatten(v, key + "."))
+                else:
+                    out[key] = str(v)[:500]  # mlflow param value limit
+            return out
+
+        try:
+            self._mlflow.log_params(_flatten(dict(cfg)))
+        except Exception:
+            pass  # params exceeding server limits must not kill the run
+
+    def close(self) -> None:
+        if self._run is not None:
+            self._mlflow.end_run()
+            self._run = None
+
+
 def get_logger(cfg: Dict[str, Any], log_dir: str) -> Optional[TensorBoardLogger]:
     if cfg.get("metric", {}).get("log_level", 1) == 0:
         return None
+    logger_cfg = cfg.get("logger", {}) or {}
+    if logger_cfg.get("name") == "mlflow":
+        from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+        if not _IS_MLFLOW_AVAILABLE:
+            raise ModuleNotFoundError(
+                "logger=mlflow requires the 'mlflow' package (reference guards it the "
+                "same way, utils/imports.py); install it or use logger=default"
+            )
+        return MlflowLogger(
+            log_dir,
+            tracking_uri=logger_cfg.get("tracking_uri"),
+            experiment_name=logger_cfg.get("experiment_name"),
+            run_name=logger_cfg.get("run_name"),
+            run_id=logger_cfg.get("run_id"),
+        )
     return TensorBoardLogger(log_dir)

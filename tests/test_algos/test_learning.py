@@ -147,6 +147,81 @@ def test_dreamer_world_model_loss_decreases(algo):
     assert last < first, f"{algo} world-model loss did not decrease: {first:.2f} -> {last:.2f}"
 
 
+_LINE_MDP_TINY = [
+    "exp=dreamer_v3_dummy",
+    "env=line_dummy",
+    "algo.dense_units=64",
+    "algo.mlp_layers=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=64",
+    "algo.world_model.transition_model.hidden_size=64",
+    "algo.world_model.representation_model.hidden_size=64",
+    "algo.world_model.discrete_size=8",
+    "algo.world_model.stochastic_size=8",
+    "algo.horizon=8",
+    "algo.per_rank_sequence_length=16",
+    "algo.learning_starts=128",
+    "algo.replay_ratio=1",
+    "algo.actor.optimizer.lr=3e-4",
+    "algo.critic.optimizer.lr=3e-4",
+    "env.num_envs=4",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "checkpoint.every=0",
+    "checkpoint.save_last=False",
+    "metric.log_every=128",
+    "buffer.size=10000",
+    "buffer.memmap=False",
+]
+
+
+def test_dreamer_v3_actor_learns_toy_mdp(tmp_path):
+    """Imagination-path learning (VERDICT r2 item 5): on the LineWalk MDP (random walk
+    ≲1.5, optimal 12) the DV3 ACTOR must improve measured return — a sign flip in
+    λ-returns, moments normalization, or the REINFORCE objective fails this even
+    though every world-model-loss test passes."""
+    run(
+        _LINE_MDP_TINY
+        + [
+            "algo.cnn_keys.encoder=[]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.per_rank_batch_size=8",
+            "algo.total_steps=1280",
+            "algo.world_model.optimizer.lr=4e-4",
+            f"log_root={tmp_path}",
+        ]
+    )
+    test_reward = _tb_scalar(tmp_path, "Test/cumulative_reward")[-1]
+    train_rewards = _tb_scalar(tmp_path, "Rewards/rew_avg")
+    best = max(max(train_rewards), test_reward)
+    assert best >= 6.0, f"DV3 actor failed to learn the toy MDP: best return {best:.1f} (< 6)"
+    assert max(train_rewards[-2:] + [test_reward]) > np.mean(train_rewards[:2]) + 2.0, (
+        f"no improvement over the start: {train_rewards} / test {test_reward}"
+    )
+
+
+def test_dreamer_v3_learns_from_pixels(tmp_path):
+    """Pixel learning (VERDICT r2 item 1): the LineWalk reward is a function of the
+    VISIBLE state only (mlp encoder off), so return can improve only if the whole
+    pixels → world model → imagination → policy loop works."""
+    run(
+        _LINE_MDP_TINY
+        + [
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "env.screen_size=32",
+            "algo.world_model.encoder.cnn_channels_multiplier=8",
+            "algo.per_rank_batch_size=4",
+            "algo.total_steps=768",
+            "algo.world_model.optimizer.lr=5e-4",
+            f"log_root={tmp_path}",
+        ]
+    )
+    test_reward = _tb_scalar(tmp_path, "Test/cumulative_reward")[-1]
+    train_rewards = _tb_scalar(tmp_path, "Rewards/rew_avg")
+    best = max(max(train_rewards), test_reward)
+    assert best >= 6.0, f"DV3 failed to learn from pixels: best return {best:.1f} (< 6)"
+
+
 def test_sac_pendulum_learns(tmp_path):
     """Random Pendulum-v1 policy averages about -1200/episode; a correctly-signed SAC
     (critic TD target, reparameterized actor, alpha) must clearly beat that within a

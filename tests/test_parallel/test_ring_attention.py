@@ -39,3 +39,18 @@ def test_ring_attention_gradients_match():
     )
     for gr, gf, name in zip(g_ring, g_ref, "qkv"):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gf), atol=5e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("window", [None, 3])
+def test_ring_attention_segments_and_window_match(window):
+    """Segment (episode-boundary) and sliding-window masks must agree with the
+    dense oracle — the masks the attention policy variant relies on."""
+    mesh = build_mesh(data=1, sequence=8)
+    rng = np.random.default_rng(3)
+    B, T, H, D = 2, 32, 2, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)).astype(np.float32)) for _ in range(3))
+    segs = jnp.asarray(np.sort(rng.integers(0, 4, (B, T)), axis=-1).astype(np.int32))
+    ring_fn = jax.jit(make_ring_attention(mesh, causal=True, window=window))
+    out = ring_fn(q, k, v, segs)
+    ref = reference_attention(q, k, v, causal=True, segment_ids=segs, window=window)
+    assert np.max(np.abs(np.asarray(out) - np.asarray(ref))) < 1e-5

@@ -1,0 +1,73 @@
+"""Logger glue tests — versioned run dir + TB fallback + the MLflow option
+(reference ``utils/logger.py:12-36`` + ``configs/logger/mlflow.yaml``)."""
+
+import sys
+import types
+
+import pytest
+
+from sheeprl_tpu.config.core import compose
+from sheeprl_tpu.utils.logger import MlflowLogger, TensorBoardLogger, get_logger
+
+
+def _stub_mlflow(monkeypatch):
+    calls = {"metrics": [], "params": [], "ended": []}
+    stub = types.ModuleType("mlflow")
+
+    class _Info:
+        run_id = "run-123"
+
+    class _Run:
+        info = _Info()
+
+    stub.set_tracking_uri = lambda uri: calls.setdefault("uri", uri)
+    stub.set_experiment = lambda name: calls.setdefault("experiment", name)
+    def _start_run(run_id=None, run_name=None):
+        calls["run_name"] = run_name
+        return _Run()
+
+    stub.start_run = _start_run
+    stub.log_metrics = lambda m, step=None: calls["metrics"].append((m, step))
+    stub.log_params = lambda p: calls["params"].append(p)
+    stub.end_run = lambda: calls["ended"].append(True)
+    monkeypatch.setitem(sys.modules, "mlflow", stub)
+    monkeypatch.setattr("sheeprl_tpu.utils.imports._IS_MLFLOW_AVAILABLE", True)
+    return calls
+
+
+def test_mlflow_logger_selected_and_logs(tmp_path, monkeypatch):
+    calls = _stub_mlflow(monkeypatch)
+    cfg = compose(overrides=["exp=ppo_dummy", "logger=mlflow", "exp_name=myexp", "run_name=r1"])
+    assert cfg.logger.name == "mlflow"
+    assert cfg.logger.experiment_name == "myexp"
+    logger = get_logger(cfg, str(tmp_path))
+    assert isinstance(logger, MlflowLogger)
+    assert logger.run_id == "run-123"
+    assert calls["experiment"] == "myexp"
+    logger.log_metrics({"Loss/policy_loss": 1.5}, step=10)
+    logger.log_hyperparams({"algo": {"name": "ppo"}})
+    logger.close()
+    assert calls["metrics"] == [({"Loss/policy_loss": 1.5}, 10)]
+    assert calls["params"] == [{"algo.name": "ppo"}]
+    assert calls["ended"] == [True]
+
+
+def test_mlflow_logger_missing_package_errors(tmp_path, monkeypatch):
+    monkeypatch.setattr("sheeprl_tpu.utils.imports._IS_MLFLOW_AVAILABLE", False)
+    cfg = compose(overrides=["exp=ppo_dummy", "logger=mlflow"])
+    with pytest.raises(ModuleNotFoundError, match="mlflow"):
+        get_logger(cfg, str(tmp_path))
+
+
+def test_default_logger_is_tensorboard(tmp_path):
+    cfg = compose(overrides=["exp=ppo_dummy"])
+    assert cfg.logger.name == "tensorboard"
+    logger = get_logger(cfg, str(tmp_path))
+    assert isinstance(logger, TensorBoardLogger)
+    logger.log_metrics({"a": 1.0}, step=1)
+    logger.close()
+
+
+def test_log_level_zero_disables_logger(tmp_path):
+    cfg = compose(overrides=["exp=ppo_dummy", "metric.log_level=0"])
+    assert get_logger(cfg, str(tmp_path)) is None
