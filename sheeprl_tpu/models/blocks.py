@@ -184,7 +184,9 @@ class LayerNormGRUCell(nn.Module):
             gamma = self.param("ln_scale", nn.initializers.ones, (3 * self.hidden_size,), jnp.float32)
             beta = self.param("ln_bias", nn.initializers.zeros, (3 * self.hidden_size,), jnp.float32)
             h_cast = h.astype(self.dtype)
-            if fused_gru_enabled() and fused.ndim == 2:
+            from sheeprl_tpu.ops.gru import fused_supported
+
+            if fused_gru_enabled() and fused.ndim == 2 and fused_supported(fused.shape[0]):
                 from sheeprl_tpu.ops.gru import fused_layernorm_gru
 
                 h_new = fused_layernorm_gru(fused, h_cast, gamma, beta, self.norm_eps)

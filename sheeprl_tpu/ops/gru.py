@@ -97,6 +97,15 @@ def _block(batch: int) -> int:
     return batch
 
 
+def fused_supported(batch: int) -> bool:
+    """The kernel runs single-tile only: the backward's per-tile ``dgamma``/``dbeta``
+    partials have ``[1, 3H]`` blocks, which Mosaic rejects when the grid has more
+    than one tile (first block dim 1 is neither 8-divisible nor the array dim).
+    Multi-tile batches (e.g. the continuous-actor imagination path at T*B rows)
+    fall back to the reference implementation."""
+    return _block(batch) == batch and batch <= 256
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def fused_layernorm_gru(proj: jax.Array, h: jax.Array, gamma: jax.Array, beta: jax.Array, eps: float = 1e-3) -> jax.Array:
     """``h' = GRUGates(LN(proj) * gamma + beta, h)`` fused in one VMEM pass.

@@ -31,7 +31,10 @@ def _block_mask(q_pos, kv_pos, causal, q_seg=None, kv_seg=None, window=None):
     if causal:
         mask = kv_pos[None, :] <= q_pos[:, None]  # [Tq, Tk]
     if window is not None:
-        w = (q_pos[:, None] - kv_pos[None, :]) < window
+        # A window always excludes the future too ("the LAST `window` positions"),
+        # so window-only attention is causal-windowed by construction.
+        delta = q_pos[:, None] - kv_pos[None, :]
+        w = (delta >= 0) & (delta < window)
         mask = w if mask is None else (mask & w)
     if mask is not None:
         mask = mask[None]  # broadcast over batch

@@ -114,9 +114,13 @@ class WindowedFutures:
         self._window_grad_steps += n_steps
         if len(self._pending) >= self._max_pending:
             # Bound the device-future backlog between flushes; the values are kept
-            # host-side so the next drain still aggregates them.
+            # host-side so the next drain still aggregates them.  If no drain ever
+            # comes (logging disabled), keep only the newest window — bounded memory
+            # beats an unobservable full history.
             self._spill.extend(jax.device_get(self._pending))
             self._pending.clear()
+            if len(self._spill) > self._max_pending:
+                del self._spill[: len(self._spill) - self._max_pending]
 
     def drain(self, aggregator) -> None:
         if not self._pending and not self._spill:
@@ -254,12 +258,3 @@ class IndexedBlockDispatcher:
         return self._futures.pop_window_sps()
 
 
-def stack_steps(entries: Sequence[Any]):
-    """Stack a list of per-step device pytrees into one ``[G, ...]`` pytree.
-
-    Pure device ops (no host round trip); the inputs are the prefetcher's
-    already-transferred per-step batches.
-    """
-    if len(entries) == 1:
-        return jax.tree.map(lambda x: x[None], entries[0])
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *entries)

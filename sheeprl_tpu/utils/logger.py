@@ -51,11 +51,19 @@ class TensorBoardLogger:
         if jax.process_index() != 0:
             return
         try:
-            from torch.utils.tensorboard import SummaryWriter
+            # tensorboardX first: pure-python writer.  torch.utils.tensorboard pulls
+            # in a TensorFlow runtime whose GL-adjacent symbols segfault MuJoCo's
+            # EGL renderer in-process (dm_control pixel envs).
+            from tensorboardX import SummaryWriter
 
             self._writer = SummaryWriter(log_dir=log_dir)
         except Exception:
-            self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._writer = SummaryWriter(log_dir=log_dir)
+            except Exception:
+                self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
 
     def log_metrics(self, metrics: Dict[str, float], step: int) -> None:
         if jax.process_index() != 0:
