@@ -109,26 +109,17 @@ def make_replay_prefetcher(rb, ctx, cfg, batch_size: int, sequence_length: int):
     step g then overlaps the transfer of slice g+1)."""
     import contextlib
 
-    import jax
     import numpy as np
-
-    sharded = ctx.data_parallel_size > 1 and batch_size % ctx.data_parallel_size == 0
-    if ctx.data_parallel_size > 1 and not sharded:
-        ctx.warn_replication_fallback(f"replay batch_size={batch_size}")
-    sharding = (
-        ctx.batch_sharding(1)  # [T, B, ...] slices: batch axis 1 over the data mesh
-        if sharded
-        else None
-    )
 
     def sample_block(n: int):
         block = rb.sample(batch_size, sequence_length=sequence_length, n_samples=n)
         out = []
         for g in range(n):
             step = {k: np.ascontiguousarray(v[g]) for k, v in block.items()}
-            out.append(
-                jax.device_put(step, sharding) if sharding is not None else jax.device_put(step)
-            )
+            # [T, B, ...] slices, batch axis 1 over the data mesh; multi-process
+            # ranks contribute their local chunk of the global batch (put_batch
+            # assembles the global array — see MeshContext.put_batch).
+            out.append(ctx.put_batch(step, batch_axis=1))
         return out
 
     if cfg.algo.get("async_prefetch", True):

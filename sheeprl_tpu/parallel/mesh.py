@@ -121,15 +121,48 @@ class MeshContext:
         """Host→device transfer with the batch axis sharded over ``data``.
 
         This is what makes every training loop actually data-parallel (the reference
-        gets this implicitly from DDP's per-process batches).  Falls back to
-        replication per-leaf when the batch axis doesn't divide the mesh — e.g. tiny
-        dry-run batches on the 8-device CI mesh — so loops never crash on shape edge
-        cases.  The fallback is a perf cliff (1-chip scaling on a multi-chip mesh),
-        so it warns once per run.
+        gets this implicitly from DDP's per-process batches).
+
+        Single process: the whole per-rank batch is the global batch, sharded over
+        the local data axis (replication fallback, with a once-per-run warning, when
+        it doesn't divide — e.g. tiny dry-run batches on the 8-device CI mesh).
+
+        Multi process: each rank's batch is its LOCAL CHUNK of the global batch
+        (global = world × per-rank, exactly the reference's per-rank DDP batches);
+        the global array is assembled with ``make_array_from_process_local_data`` —
+        a plain ``device_put`` would require every process to pass identical data.
+        The per-rank batch must divide the LOCAL device count; anything else raises
+        (a silent per-process fallback would let replicas train on different
+        "replicated" data and diverge).
         """
         dp = self.data_parallel_size
         sh = self.batch_sharding(batch_axis)
         rep = self.replicated
+
+        if jax.process_count() > 1:
+            if dp < jax.process_count():
+                # With no data axis spanning the processes there is nothing to
+                # shard the per-rank batches over: a "replicated" global array
+                # built from different per-rank data would silently diverge the
+                # replicas (JAX does not value-check process-local assembly).
+                raise ValueError(
+                    f"Multi-process runs need the data mesh axis to span the "
+                    f"processes (data={dp} < processes={jax.process_count()}); "
+                    f"lower mesh.model/mesh.sequence or add devices."
+                )
+            local_dp = max(dp // jax.process_count(), 1)
+
+            def _put(x):
+                x = np.asarray(x)
+                if x.ndim > batch_axis and x.shape[batch_axis] % local_dp == 0:
+                    return jax.make_array_from_process_local_data(sh, x)
+                raise ValueError(
+                    f"Multi-process data parallelism needs the per-rank batch axis "
+                    f"{batch_axis} (shape {x.shape}) to divide the {local_dp} local "
+                    f"data-axis device(s); adjust per_rank_batch_size/num_envs."
+                )
+
+            return jax.tree.map(_put, tree)
 
         def _put(x):
             divisible = x.ndim > batch_axis and x.shape[batch_axis] % dp == 0

@@ -98,10 +98,12 @@ class WindowedFutures:
     grad-steps/s over the window's wall-clock.
     """
 
-    def __init__(self, max_pending: int = 256):
+    def __init__(self, max_pending: int = 256, max_spill: int = 8192):
         self._pending: List[Any] = []
         self._spill: List[Any] = []  # host-side metrics fetched early (backlog cap)
         self._max_pending = max_pending
+        self._max_spill = max_spill
+        self._warned_trim = False
         self._window_grad_steps = 0
         self._window_t0 = 0.0
 
@@ -114,13 +116,25 @@ class WindowedFutures:
         self._window_grad_steps += n_steps
         if len(self._pending) >= self._max_pending:
             # Bound the device-future backlog between flushes; the values are kept
-            # host-side so the next drain still aggregates them.  If no drain ever
-            # comes (logging disabled), keep only the newest window — bounded memory
-            # beats an unobservable full history.
+            # host-side so the next drain still aggregates them.  Only if no drain
+            # ever comes (e.g. logging disabled) does the spill itself get trimmed —
+            # bounded memory beats an unobservable full history — and trimming warns
+            # once, since with logging enabled it means log_every spans more blocks
+            # than the window can hold.
             self._spill.extend(jax.device_get(self._pending))
             self._pending.clear()
-            if len(self._spill) > self._max_pending:
-                del self._spill[: len(self._spill) - self._max_pending]
+            if len(self._spill) > self._max_spill:
+                if not self._warned_trim:
+                    self._warned_trim = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "metrics window exceeded %d gradient blocks without a drain; "
+                        "oldest entries dropped (lower metric.log_every to keep full "
+                        "window statistics).",
+                        self._max_spill,
+                    )
+                del self._spill[: len(self._spill) - self._max_spill]
 
     def drain(self, aggregator) -> None:
         if not self._pending and not self._spill:
