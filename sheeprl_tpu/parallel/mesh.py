@@ -150,7 +150,12 @@ class MeshContext:
                     f"processes (data={dp} < processes={jax.process_count()}); "
                     f"lower mesh.model/mesh.sequence or add devices."
                 )
-            local_dp = max(dp // jax.process_count(), 1)
+            if dp % jax.process_count() != 0:
+                raise ValueError(
+                    f"The data mesh axis ({dp}) must divide evenly across the "
+                    f"{jax.process_count()} processes for per-rank batch assembly."
+                )
+            local_dp = dp // jax.process_count()
 
             def _put(x):
                 x = np.asarray(x)
@@ -164,13 +169,22 @@ class MeshContext:
 
             return jax.tree.map(_put, tree)
 
+        leaves = jax.tree.leaves(tree)
+        all_divisible = all(
+            getattr(x, "ndim", 0) > batch_axis and x.shape[batch_axis] % dp == 0 for x in leaves
+        )
+        if dp <= 1 or all_divisible:
+            # ONE pytree device_put — per-leaf dispatches would each pay the
+            # round-trip overhead on remote accelerators.
+            return jax.device_put(tree, sh if dp > 1 else rep)
+
         def _put(x):
             divisible = x.ndim > batch_axis and x.shape[batch_axis] % dp == 0
-            if dp > 1 and not divisible:
+            if not divisible:
                 self.warn_replication_fallback(
                     f"batch axis {batch_axis} of shape {getattr(x, 'shape', '?')}"
                 )
-            return jax.device_put(x, sh if (dp > 1 and divisible) else rep)
+            return jax.device_put(x, sh if divisible else rep)
 
         return jax.tree.map(_put, tree)
 

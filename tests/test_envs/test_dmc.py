@@ -1,25 +1,54 @@
 """DMC adapter + shipped DMC presets actually instantiate (the preset-composition
-test alone missed wrapper kwargs that DMCWrapper does not accept)."""
+test alone missed wrapper kwargs that DMCWrapper does not accept).
 
-import os
+Runs in a SUBPROCESS: MuJoCo's EGL renderer segfaults in any process that has
+loaded a TensorFlow runtime, and earlier suite tests import
+``tensorboard.backend...EventAccumulator`` (see utils/logger.py for the same
+issue on the training side, solved by preferring tensorboardX).
+"""
 
-import numpy as np
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
 import pytest
 
-dm_control = pytest.importorskip("dm_control")
-os.environ.setdefault("MUJOCO_GL", "egl")
+pytest.importorskip("dm_control")
 
+REPO = Path(__file__).resolve().parents[2]
 
-@pytest.mark.parametrize("exp", ["dreamer_v3_dmc_walker_walk", "dreamer_v3_dmc_cartpole_swingup_sparse"])
-def test_dmc_preset_env_instantiates(exp):
+CHILD = textwrap.dedent(
+    """
+    import os, sys
+    os.environ.setdefault("MUJOCO_GL", "egl")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["SHEEPRL_TPU_QUIET"] = "1"
+    sys.path.insert(0, {repo!r})
+    import numpy as np
     from sheeprl_tpu.config.core import compose
     from sheeprl_tpu.utils.env import make_env
 
-    cfg = compose(overrides=[f"exp={exp}", "env.capture_video=False"])
+    exp = sys.argv[1]
+    cfg = compose(overrides=[f"exp={{exp}}", "env.capture_video=False"])
     env = make_env(cfg, seed=0, rank=0)()
     obs, _ = env.reset(seed=0)
-    assert obs["rgb"].shape == (3, cfg.env.screen_size, cfg.env.screen_size)
+    assert obs["rgb"].shape == (3, cfg.env.screen_size, cfg.env.screen_size), obs["rgb"].shape
     assert obs["rgb"].dtype == np.uint8
     obs, reward, term, trunc, _ = env.step(env.action_space.sample())
     assert np.isfinite(reward)
     env.close()
+    print(f"dmc {{exp}} OK", flush=True)
+    """
+).format(repo=str(REPO))
+
+
+@pytest.mark.parametrize("exp", ["dreamer_v3_dmc_walker_walk", "dreamer_v3_dmc_cartpole_swingup_sparse"])
+def test_dmc_preset_env_instantiates(tmp_path, exp):
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+    proc = subprocess.run(
+        [sys.executable, str(script), exp], capture_output=True, text=True, timeout=300
+    )
+    assert proc.returncode == 0, f"{exp} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    assert f"dmc {exp} OK" in proc.stdout
