@@ -47,7 +47,7 @@ from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.prefetch import make_replay_prefetcher
+from sheeprl_tpu.data.device_buffer import make_device_replay, sample_index_block
 from sheeprl_tpu.distributions import (
     BernoulliSafeMode,
     Independent,
@@ -56,7 +56,6 @@ from sheeprl_tpu.distributions import (
     SymlogDistribution,
     TwoHotEncodingDistribution,
 )
-from sheeprl_tpu.utils.blocks import BlockDispatcher, IndexedBlockDispatcher
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, make_aggregator, record_episode_stats
@@ -365,27 +364,6 @@ def main(ctx, cfg) -> None:
     # the host→device batch traffic that otherwise floors e2e throughput.  Falls
     # back to host sampling + async prefetch under multi-chip data parallelism
     # (the mirror is single-device) or when disabled.
-    use_device_buffer = bool(cfg.buffer.get("device", False))
-    if use_device_buffer and ctx.data_parallel_size > 1:
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "buffer.device=True is single-chip only (the mirror is not sharded); "
-            "falling back to host-side sampling with the async prefetcher."
-        )
-        use_device_buffer = False
-    seq_len_cfg = cfg.algo.per_rank_sequence_length
-    if use_device_buffer:
-        from sheeprl_tpu.data.device_buffer import gather_sequences
-
-        dispatcher = IndexedBlockDispatcher(
-            _block_step,
-            gather_fn=lambda mirror, e, s: gather_sequences(mirror, e, s, seq_len_cfg),
-            target_update_freq=target_update_freq,
-            base_key=ctx.rng(),
-        )
-    else:
-        dispatcher = BlockDispatcher(_block_step, target_update_freq, base_key=ctx.rng())
 
     player_step = make_player_step(world_model, actor, actions_dim, cfg.algo.world_model.discrete_size)
     player_jit = jax.jit(player_step, static_argnames=("greedy",))
@@ -410,17 +388,20 @@ def main(ctx, cfg) -> None:
     )
     rb.seed(cfg.seed + rank)
 
-    mirror = None
-    if use_device_buffer:
-        from sheeprl_tpu.data.device_buffer import make_mirror_for
-
-        mirror = make_mirror_for(
-            rb,
-            cnn_keys,
-            mlp_keys,
-            obs_space,
-            [("actions", act_dim_sum), ("rewards", 1), ("terminated", 1), ("truncated", 1), ("is_first", 1)],
-        )
+    # Device-vs-host replay data path, one shared implementation
+    # (data/device_buffer.py): HBM mirror + index-only sampling when
+    # buffer.device=True on a single chip, async host prefetch otherwise.
+    dispatcher, mirror, prefetcher, rb_lock, _sample_block, rb_add = make_device_replay(
+        ctx,
+        cfg,
+        rb,
+        cnn_keys,
+        mlp_keys,
+        obs_space,
+        act_dim_sum,
+        _block_step,
+        dispatcher_kwargs=dict(target_update_freq=target_update_freq),
+    )
 
     # rank-independent (cross-process gathering) when multi-host
     aggregator = make_aggregator(cfg.metric.aggregator.get("metrics", {}))
@@ -479,25 +460,6 @@ def main(ctx, cfg) -> None:
             row[k] = v.reshape(1, v.shape[0], -1)
         return row
 
-    # Double-buffered sampling: the next [G, T, B] block is drawn + shipped to the
-    # device while the current block's gradient steps execute (SURVEY §7).  The
-    # device-resident mirror needs neither: sampling is index-only.
-    if use_device_buffer:
-        import contextlib
-
-        prefetcher, rb_lock, _sample_block = None, contextlib.nullcontext(), None
-    else:
-        prefetcher, rb_lock, _sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
-
-    def rb_add(data, indices=None, validate_args=False):
-        """Host add + device-mirror scatter (the mirror writes at each target
-        env's pre-add cursor)."""
-        if mirror is not None:
-            envs_sel = list(indices) if indices is not None else list(range(num_envs))
-            positions = [rb.buffer[e]._pos for e in envs_sel]
-            mirror.add(data, envs_sel, positions)
-        with rb_lock:
-            rb.add(data, indices=indices, validate_args=validate_args)
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
     player_state = player_state_init(num_envs)
@@ -566,9 +528,7 @@ def main(ctx, cfg) -> None:
                 )
                 if grad_steps > 0:
                     if mirror is not None:
-                        idx = [rb.sample_idx(batch_size, seq_len) for _ in range(grad_steps)]
-                        envs_idx = np.stack([e for e, _ in idx])
-                        starts_idx = np.stack([st for _, st in idx])
+                        envs_idx, starts_idx = sample_index_block(rb, batch_size, seq_len, grad_steps)
                         params, opt_states, moments_state = dispatcher.dispatch(
                             (params, opt_states, moments_state),
                             mirror.arrays,

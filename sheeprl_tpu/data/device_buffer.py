@@ -107,6 +107,114 @@ class DeviceReplayMirror:
             self.arrays[k] = jax.device_put(host)
 
 
+def device_replay_enabled(ctx, cfg, require_sequential: bool = False) -> bool:
+    """The ``buffer.device`` gate shared by the Dreamer loops: single-chip only
+    (the mirror is not sharded) and — for DV2 — sequential buffers only.  Every
+    fallback logs why, so a requested device buffer never degrades silently."""
+    import logging
+
+    if not bool(cfg.buffer.get("device", False)):
+        return False
+    log = logging.getLogger(__name__)
+    if require_sequential and str(cfg.buffer.get("type", "sequential")).lower() != "sequential":
+        log.warning(
+            "buffer.device=True supports only buffer.type=sequential (the episode "
+            "buffer stays on host); falling back to host sampling."
+        )
+        return False
+    if ctx.data_parallel_size > 1:
+        log.warning(
+            "buffer.device=True is single-chip only (the mirror is not sharded); "
+            "falling back to host-side sampling with the async prefetcher."
+        )
+        return False
+    return True
+
+
+def make_rb_add(mirror: Optional[DeviceReplayMirror], rb, rb_lock, num_envs: int):
+    """The loops' row-append: host add + device-mirror scatter at each target env's
+    pre-add cursor.  The env-subset argument is passed POSITIONALLY — the
+    EnvIndependentReplayBuffer and EpisodeBuffer name it differently."""
+
+    def rb_add(data, indices=None, validate_args=False):
+        if mirror is not None:
+            envs_sel = list(indices) if indices is not None else list(range(num_envs))
+            positions = [rb.buffer[e]._pos for e in envs_sel]
+            mirror.add(data, envs_sel, positions)
+        with rb_lock:
+            rb.add(data, indices, validate_args=validate_args)
+
+    return rb_add
+
+
+def sample_index_block(rb, batch_size: int, sequence_length: int, n: int):
+    """``n`` gradient steps' worth of (env, start) index pairs as ``[n, B]`` arrays
+    for :class:`~sheeprl_tpu.utils.blocks.IndexedBlockDispatcher`."""
+    idx = [rb.sample_idx(batch_size, sequence_length) for _ in range(n)]
+    return np.stack([e for e, _ in idx]), np.stack([s for _, s in idx])
+
+
+def make_device_replay(
+    ctx,
+    cfg,
+    rb,
+    cnn_keys,
+    mlp_keys,
+    obs_space,
+    act_dim_sum: int,
+    step_fn,
+    dispatcher_kwargs: Optional[dict] = None,
+    require_sequential: bool = False,
+):
+    """One-stop wiring for the Dreamer-family loops — the single implementation of
+    the device-vs-host replay data path.
+
+    Returns ``(dispatcher, mirror, prefetcher, rb_lock, sample_block, rb_add)``:
+
+    * device path (``buffer.device=True``, single chip): an
+      :class:`~sheeprl_tpu.utils.blocks.IndexedBlockDispatcher` gathering from the
+      HBM mirror in-jit; no prefetcher (sampling is index-only);
+    * host path: a :class:`~sheeprl_tpu.utils.blocks.BlockDispatcher` fed by the
+      async double-buffered prefetcher.
+
+    ``step_fn``/``dispatcher_kwargs`` are the loop's per-step train closure and its
+    cadence options (``target_update_freq``, ``count_offset``); call AFTER the
+    replay buffer exists, and call ``mirror.load_from(rb)`` after a resume restores
+    the host buffer.
+    """
+    import contextlib
+
+    from sheeprl_tpu.data.prefetch import make_replay_prefetcher
+    from sheeprl_tpu.utils.blocks import BlockDispatcher, IndexedBlockDispatcher
+
+    kwargs = dict(dispatcher_kwargs or {})
+    kwargs.setdefault("base_key", ctx.rng())
+    batch_size = cfg.algo.per_rank_batch_size
+    seq_len = cfg.algo.per_rank_sequence_length
+
+    if device_replay_enabled(ctx, cfg, require_sequential=require_sequential):
+        mirror = make_mirror_for(
+            rb,
+            cnn_keys,
+            mlp_keys,
+            obs_space,
+            [("actions", act_dim_sum), ("rewards", 1), ("terminated", 1), ("truncated", 1), ("is_first", 1)],
+        )
+        dispatcher = IndexedBlockDispatcher(
+            step_fn,
+            gather_fn=lambda m, e, s: gather_sequences(m, e, s, seq_len),
+            **kwargs,
+        )
+        prefetcher, rb_lock, sample_block = None, contextlib.nullcontext(), None
+    else:
+        mirror = None
+        dispatcher = BlockDispatcher(step_fn, **kwargs)
+        prefetcher, rb_lock, sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
+
+    rb_add = make_rb_add(mirror, rb, rb_lock, rb.n_envs)
+    return dispatcher, mirror, prefetcher, rb_lock, sample_block, rb_add
+
+
 def make_mirror_for(rb, cnn_keys, mlp_keys, obs_space, extra_float_keys) -> DeviceReplayMirror:
     """Build a mirror matching the Dreamer loops' row layout (``_obs_row``): pixel
     keys are stored ``[C_total, H, W]`` uint8 (decoded to float on device inside

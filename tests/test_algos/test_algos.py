@@ -198,6 +198,24 @@ def test_dreamer_v3_device_buffer(tmp_path):
     assert _ckpts(tmp_path), "no checkpoint written"
 
 
+@pytest.mark.parametrize("algo", ["dreamer_v1", "dreamer_v2"])
+def test_dreamer_v12_device_buffer(tmp_path, algo):
+    """buffer.device=True on the DV1/DV2 loops (same HBM-resident replay path as
+    DV3; DV2 gated to the sequential buffer type)."""
+    run(
+        [
+            f"exp={algo}_dummy",
+            "env=discrete_dummy",
+            "buffer.device=True",
+            "mesh.devices=1",
+            "algo.total_steps=32",
+            "algo.learning_starts=16",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    assert _ckpts(tmp_path), "no checkpoint written"
+
+
 def test_ppo_recurrent_attention_sequence_model(tmp_path):
     """The attention sequence-model variant trains end-to-end (dense path)."""
     run(
