@@ -61,7 +61,7 @@ def _peak_flops(device) -> float:
     return 275e12  # assume v4 when unknown
 
 
-def bench_train_only():
+def bench_train_only(size: str = "S"):
     import jax
     import jax.numpy as jnp
 
@@ -76,7 +76,7 @@ def bench_train_only():
     cfg = compose(
         overrides=[
             "exp=dreamer_v3",
-            "algo=dreamer_v3_S",
+            f"algo=dreamer_v3_{size}",
             "algo.per_rank_batch_size=16",
             "algo.per_rank_sequence_length=64",
         ]
@@ -141,14 +141,21 @@ def bench_train_only():
     return gsps, mfu
 
 
-def bench_e2e():
-    """Real training loop (env + buffer + prefetch + train) on the dummy env."""
+def bench_e2e(replay_ratio: int = 1, total_steps: int | None = None, prefix: str = ""):
+    """Real training loop (env + buffer + prefetch + train) on the dummy env.
+
+    ``replay_ratio=4`` is the second bench point the round-3 profile predicted would
+    amortise the tunnel's acting round trip over a 4×-larger gradient block
+    (``PROFILE_r03.md``): the prediction was ``e2e_sps_train / train_only ≈ 0.72``
+    at R=4 vs the measured 0.40 at R=1.
+    """
     from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
 
     from sheeprl_tpu.cli import run
 
     tmp = tempfile.mkdtemp(prefix="bench_e2e_")
-    total_steps = int(os.environ.get("BENCH_E2E_STEPS", "768"))
+    if total_steps is None:
+        total_steps = int(os.environ.get("BENCH_E2E_STEPS", "768"))
     t0 = time.perf_counter()
     try:
         run(
@@ -164,7 +171,7 @@ def bench_e2e():
                 "env.capture_video=False",
                 f"algo.total_steps={total_steps}",
                 "algo.learning_starts=256",
-                "algo.replay_ratio=1",
+                f"algo.replay_ratio={replay_ratio}",
                 "algo.per_rank_batch_size=16",
                 "algo.per_rank_sequence_length=64",
                 "algo.run_test=False",
@@ -182,14 +189,14 @@ def bench_e2e():
             ]
         )
         elapsed = time.perf_counter() - t0
-        out = {"e2e_policy_steps_per_sec": round(total_steps / elapsed, 3)}
+        out = {f"{prefix}e2e_policy_steps_per_sec": round(total_steps / elapsed, 3)}
         runs = sorted(glob.glob(os.path.join(tmp, "**", "version_*"), recursive=True))
         if runs:
             ea = EventAccumulator(runs[-1])
             ea.Reload()
             for tag, key in (
-                ("Time/sps_train", "e2e_sps_train"),
-                ("Time/sps_env_interaction", "e2e_sps_env_interaction"),
+                ("Time/sps_train", f"{prefix}e2e_sps_train"),
+                ("Time/sps_env_interaction", f"{prefix}e2e_sps_env_interaction"),
             ):
                 if tag in ea.Tags()["scalars"]:
                     vals = [s.value for s in ea.Scalars(tag)]
@@ -210,6 +217,15 @@ def main() -> None:
             extras = bench_e2e()
         except Exception as exc:  # the headline number must still print
             extras = {"e2e_error": str(exc)[:200]}
+        # Second point at replay ratio 4: measures the RTT-amortisation claim
+        # (PROFILE_r03.md predicted ~0.72× train-only; r1 measured 0.40×).
+        if os.environ.get("BENCH_E2E_R4", "1") != "0":
+            try:
+                extras.update(bench_e2e(replay_ratio=4, total_steps=512, prefix="r4_"))
+                if "r4_e2e_sps_train" in extras and gsps > 0:
+                    extras["r4_e2e_over_train_only"] = round(extras["r4_e2e_sps_train"] / gsps, 4)
+            except Exception as exc:
+                extras["r4_e2e_error"] = str(exc)[:200]
     # Honest comparison: reference published only an end-to-end wall-clock, so compare
     # e2e-to-e2e; the train-only rate has no published counterpart.
     if "e2e_sps_train" in extras:

@@ -1,0 +1,28 @@
+"""Train-only MFU sweep over DreamerV3 model sizes (VERDICT r3 #5).
+
+Round 3 left MFU at ~0.17 for size S with the unmeasured claim that the T=64 RSSM /
+H=15 imagination scans are latency-bound at S and that larger models lift arithmetic
+intensity.  This probe measures grad-steps/s + MFU for sizes S/M/L (same batch 16 ×
+seq 64 × 64×64×3 config) on the real chip and prints one JSON line per size, feeding
+``PROFILE_r04.md``.
+
+Usage: ``python benchmarks/mfu_sweep.py [S M L]``
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from bench import bench_train_only  # noqa: E402
+
+
+def main() -> None:
+    sizes = sys.argv[1:] or ["S", "M", "L"]
+    for size in sizes:
+        gsps, mfu = bench_train_only(size)
+        print(json.dumps({"size": size, "grad_steps_per_sec": round(gsps, 4), "mfu": round(mfu, 4)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""Decode a trained DreamerV3 world model's imagination to PNG strips.
+
+Parity artifact for the reference's ``notebooks/dreamer_v3_imagination.ipynb``:
+load a checkpoint, run the trained (greedy) player for ``context`` real env steps
+so the RSSM posterior locks onto the episode, then let the world model imagine
+``horizon`` steps on its own — actions chosen by the trained actor on the imagined
+latents, next stochastic states from the prior (no observations) — and decode
+everything back to pixels.
+
+The output strip has three rows:
+
+1. real frames (the env's ground truth over the context + horizon window);
+2. posterior reconstructions (what the world model decodes while it still SEES
+   the frames — reconstruction quality);
+3. the same context reconstructions followed by the pure imagination rollout
+   (what the behaviour learns from — dream quality).
+
+Usage::
+
+    python examples/imagination.py checkpoint_path=<run>/checkpoints/ckpt_N \
+        [context=5] [horizon=15] [out=imagination.png] [env overrides...]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    overrides = sys.argv[1:]
+    opts = {"context": 5, "horizon": 15, "out": "imagination.png"}
+    passthrough = []
+    for ov in overrides:
+        key = ov.partition("=")[0]
+        if key in opts:
+            val = ov.partition("=")[2]
+            opts[key] = int(val) if key != "out" else val
+        else:
+            passthrough.append(ov)
+    if opts["context"] < 1 or opts["horizon"] < 1:
+        raise SystemExit("context and horizon must both be >= 1 (the imagination rollout starts from the last posterior)")
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import (
+        PlayerState,
+        WorldModel,
+        build_agent,
+        make_player_step,
+        parse_actions_dim,
+    )
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+    from sheeprl_tpu.cli import _load_checkpoint_cfg
+    from sheeprl_tpu.parallel.mesh import make_mesh_context
+    from sheeprl_tpu.utils.env import make_env
+
+    cfg, ckpt_path = _load_checkpoint_cfg(passthrough, "checkpoint_path")
+    cfg.env.capture_video = False
+    ctx = make_mesh_context(cfg)
+
+    env = make_env(cfg, cfg.seed, 0, None, "imagination")()
+    obs_space = env.observation_space
+    is_continuous, actions_dim = parse_actions_dim(env.action_space)
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    if not cnn_keys:
+        raise SystemExit("imagination decoding needs at least one pixel key (algo.cnn_keys.encoder)")
+
+    world_model, actor, critic, params, _ = build_agent(ctx, actions_dim, is_continuous, cfg, obs_space)
+    params = ctx.replicate(CheckpointManager.load(ckpt_path, templates={"params": jax.device_get(params)})["params"])
+    player_step = jax.jit(
+        make_player_step(world_model, actor, actions_dim, cfg.algo.world_model.discrete_size),
+        static_argnames=("greedy",),
+    )
+
+    stoch_size = cfg.algo.world_model.stochastic_size * cfg.algo.world_model.discrete_size
+    rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
+    state = PlayerState(
+        recurrent_state=jnp.zeros((1, rec_size)),
+        stochastic_state=jnp.zeros((1, stoch_size)),
+        actions=jnp.zeros((1, int(sum(actions_dim)))),
+    )
+
+    def obs_tree(o):
+        t = {}
+        for k in cnn_keys:
+            v = np.asarray(o[k])
+            t[k] = jnp.asarray(v.reshape(1, -1, *v.shape[-2:]))
+        for k in mlp_keys:
+            t[k] = jnp.asarray(np.asarray(o[k], np.float32).reshape(1, -1))
+        return t
+
+    wm = params["world_model"]
+    key = jax.random.PRNGKey(cfg.seed)
+
+    def decode_frame(stoch, recurrent):
+        latent = jnp.concatenate([stoch, recurrent], -1)
+        recon = world_model.apply(wm, latent, method=WorldModel.decode)
+        img = np.asarray(recon[cnn_keys[0]][0], np.float32)  # [C, H, W], ~[-0.5, 0.5]
+        return np.clip((img + 0.5) * 255.0, 0, 255).astype(np.uint8)
+
+    # --- context: real steps through the trained player (posterior latents)
+    obs, _ = env.reset(seed=cfg.seed)
+    is_first = jnp.ones((1, 1))
+    real_frames, recon_frames = [], []
+    total = opts["context"] + opts["horizon"]
+    for t in range(total):
+        key, sub = jax.random.split(key)
+        actions, stored, state = player_step(params, state, obs_tree(obs), is_first, sub, greedy=True)
+        is_first = jnp.zeros((1, 1))
+        raw = np.asarray(obs[cnn_keys[0]]).reshape(-1, *np.asarray(obs[cnn_keys[0]]).shape[-2:])
+        real_frames.append(raw[:3].astype(np.uint8))
+        recon_frames.append(decode_frame(state.stochastic_state, state.recurrent_state))
+        if t == opts["context"] - 1:
+            break_state = state  # imagination starts from the last posterior
+        acts = jax.device_get(actions)
+        env_action = (
+            np.asarray(acts[0][0])
+            if is_continuous
+            else (np.asarray(acts[0][0]).argmax(-1) if len(actions_dim) == 1 else np.stack([np.asarray(a[0]).argmax(-1) for a in acts], -1))
+        )
+        obs, _, terminated, truncated, _ = env.step(env_action)
+        if terminated or truncated:
+            obs, _ = env.reset()
+            is_first = jnp.ones((1, 1))
+    env.close()
+
+    # --- imagination: prior-only rollout from the end of the context
+    stoch, recurrent = break_state.stochastic_state, break_state.recurrent_state
+    imag_frames = recon_frames[: opts["context"]]
+    for _ in range(opts["horizon"]):
+        key, k_act, k_dyn = jax.random.split(key, 3)
+        latent = jnp.concatenate([stoch, recurrent], -1)
+        acts, _ = actor.apply(params["actor"], latent, k_act, False, None)
+        action = jnp.concatenate(acts, -1)
+        stoch, recurrent = world_model.apply(wm, stoch, recurrent, action, k_dyn, method=WorldModel.imagination)
+        imag_frames.append(decode_frame(stoch, recurrent))
+
+    # --- compose the three-row strip
+    def row(frames):
+        return np.concatenate([np.transpose(f[:3], (1, 2, 0)) for f in frames], axis=1)
+
+    rows = [row(real_frames), row(recon_frames), row(imag_frames)]
+    strip = np.concatenate(rows, axis=0)
+    try:
+        import cv2
+
+        cv2.imwrite(opts["out"], cv2.cvtColor(strip, cv2.COLOR_RGB2BGR))
+    except ImportError:
+        from PIL import Image
+
+        Image.fromarray(strip).save(opts["out"])
+    print(
+        f"wrote {opts['out']}: rows = real | posterior recon | imagination "
+        f"({opts['context']} context + {opts['horizon']} imagined steps)"
+    )
+
+
+if __name__ == "__main__":
+    main()

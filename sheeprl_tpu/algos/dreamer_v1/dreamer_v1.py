@@ -43,7 +43,7 @@ from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.device_buffer import make_device_replay, sample_index_block
+from sheeprl_tpu.data.device_buffer import make_device_replay
 from sheeprl_tpu.distributions import BernoulliSafeMode, Independent, Normal
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -259,7 +259,7 @@ def main(ctx, cfg) -> None:
     # Device-vs-host replay data path, one shared implementation
     # (data/device_buffer.py): HBM mirror + index-only sampling when
     # buffer.device=True on a single chip, async host prefetch otherwise.
-    dispatcher, mirror, prefetcher, rb_lock, _sample_block, rb_add = make_device_replay(
+    dispatcher, mirror, prefetcher, _run_block, rb_add = make_device_replay(
         ctx, cfg, rb, cnn_keys, mlp_keys, obs_space, act_dim_sum, _block_step
     )
 
@@ -370,20 +370,9 @@ def main(ctx, cfg) -> None:
                 (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
             )
             if grad_steps > 0:
-                if mirror is not None:
-                    envs_idx, starts_idx = sample_index_block(rb, batch_size, seq_len, grad_steps)
-                    params, opt_states = dispatcher.dispatch(
-                        (params, opt_states), mirror.arrays, envs_idx, starts_idx, cumulative_grad_steps
-                    )
-                else:
-                    sample = (
-                        prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
-                        if prefetcher is not None
-                        else _sample_block(grad_steps)
-                    )
-                    params, opt_states = dispatcher.dispatch(
-                        (params, opt_states), sample, cumulative_grad_steps
-                    )
+                params, opt_states = _run_block(
+                    (params, opt_states), grad_steps, cumulative_grad_steps, stage_next=iter_num < num_iters
+                )
                 cumulative_grad_steps += grad_steps
 
         env_t0 = time.perf_counter()

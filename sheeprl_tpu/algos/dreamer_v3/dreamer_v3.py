@@ -47,7 +47,7 @@ from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.device_buffer import make_device_replay, sample_index_block
+from sheeprl_tpu.data.device_buffer import make_device_replay
 from sheeprl_tpu.distributions import (
     BernoulliSafeMode,
     Independent,
@@ -361,9 +361,10 @@ def main(ctx, cfg) -> None:
 
     # Device-resident replay (buffer.device): rows live in HBM, the host ships only
     # (env, start) indices, and each scan step gathers its batch in-jit — removes
-    # the host→device batch traffic that otherwise floors e2e throughput.  Falls
-    # back to host sampling + async prefetch under multi-chip data parallelism
-    # (the mirror is single-device) or when disabled.
+    # the host→device batch traffic that otherwise floors e2e throughput.  Under
+    # data parallelism the ring's env axis is sharded over the `data` mesh axis
+    # (per-shard sampling + shard_map gather); only multi-process runs fall back
+    # to host sampling + async prefetch.
 
     player_step = make_player_step(world_model, actor, actions_dim, cfg.algo.world_model.discrete_size)
     player_jit = jax.jit(player_step, static_argnames=("greedy",))
@@ -391,7 +392,7 @@ def main(ctx, cfg) -> None:
     # Device-vs-host replay data path, one shared implementation
     # (data/device_buffer.py): HBM mirror + index-only sampling when
     # buffer.device=True on a single chip, async host prefetch otherwise.
-    dispatcher, mirror, prefetcher, rb_lock, _sample_block, rb_add = make_device_replay(
+    dispatcher, mirror, prefetcher, _run_block, rb_add = make_device_replay(
         ctx,
         cfg,
         rb,
@@ -527,24 +528,12 @@ def main(ctx, cfg) -> None:
                     (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
                 )
                 if grad_steps > 0:
-                    if mirror is not None:
-                        envs_idx, starts_idx = sample_index_block(rb, batch_size, seq_len, grad_steps)
-                        params, opt_states, moments_state = dispatcher.dispatch(
-                            (params, opt_states, moments_state),
-                            mirror.arrays,
-                            envs_idx,
-                            starts_idx,
-                            cumulative_grad_steps,
-                        )
-                    else:
-                        sample = (
-                            prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
-                            if prefetcher is not None
-                            else _sample_block(grad_steps)
-                        )
-                        params, opt_states, moments_state = dispatcher.dispatch(
-                            (params, opt_states, moments_state), sample, cumulative_grad_steps
-                        )
+                    params, opt_states, moments_state = _run_block(
+                        (params, opt_states, moments_state),
+                        grad_steps,
+                        cumulative_grad_steps,
+                        stage_next=iter_num < num_iters,
+                    )
                     cumulative_grad_steps += grad_steps
 
             env_t0 = time.perf_counter()

@@ -22,8 +22,7 @@ from sheeprl_tpu.algos.p2e_dv1.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.prefetch import make_replay_prefetcher
-from sheeprl_tpu.utils.blocks import BlockDispatcher
+from sheeprl_tpu.data.device_buffer import make_device_replay
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -67,8 +66,6 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
         params, opt_states = carry
         params, opt_states, metrics = train_step(params, opt_states, batch, key)
         return (params, opt_states), metrics
-
-    dispatcher = BlockDispatcher(_block_step, base_key=ctx.rng())
 
     def task_view(p):
         return {"world_model": p["world_model"], "actor": p["actor_task"], "critic": p["critic_task"]}
@@ -126,6 +123,15 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
     if (resume_from or cfg.buffer.get("load_from_exploration")) and "rb" in state:
         rb.load_state_dict(state["rb"])
 
+    # Device-vs-host replay data path, one shared implementation
+    # (data/device_buffer.py); the mirror is rebuilt from the restored host buffer
+    # (resume or exploration hand-off) before training starts.
+    dispatcher, mirror, prefetcher, _run_block, rb_add = make_device_replay(
+        ctx, cfg, rb, cnn_keys, mlp_keys, obs_space, act_dim_sum, _block_step
+    )
+    if mirror is not None and len(rb) > 0:
+        mirror.load_from(rb)
+
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
@@ -164,10 +170,6 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             row[k] = v.reshape(1, v.shape[0], -1)
         return row
 
-    # Double-buffered sampling: the next [G, T, B] block is drawn + shipped to the
-    # device while the current block's gradient steps execute (SURVEY §7).
-    prefetcher, rb_lock, _sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
-
     obs, _ = envs.reset(seed=cfg.seed + rank)
     player_state = player_state_init(num_envs)
     step_data: Dict[str, np.ndarray] = _obs_row(obs)
@@ -201,8 +203,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
                 env_actions = np.stack([a.argmax(-1) for a in acts_np], -1)
 
             step_data["actions"] = stored_actions.reshape(1, num_envs, -1)
-            with rb_lock:
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            rb_add(step_data, validate_args=cfg.buffer.validate_args)
         env_time = time.perf_counter() - env_t0
 
         # Dispatch this iteration's gradient block BEFORE stepping the envs: the
@@ -218,14 +219,8 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
                 (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
             )
             if grad_steps > 0:
-                sample = (
-                    prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
-                    if prefetcher is not None
-                    else _sample_block(grad_steps)
-                )
-                view = task_view(params)
-                view, opt_states = dispatcher.dispatch(
-                    (view, opt_states), sample, cumulative_grad_steps
+                view, opt_states = _run_block(
+                    (task_view(params), opt_states), grad_steps, cumulative_grad_steps, stage_next=iter_num < num_iters
                 )
                 params = merge_task_view(params, view)
                 cumulative_grad_steps += grad_steps
@@ -259,8 +254,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
                 reset_data["truncated"] = step_data["truncated"][:, done_idxs]
                 reset_data["actions"] = np.zeros((1, len(done_idxs), act_dim_sum), np.float32)
                 reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-                with rb_lock:
-                    rb.add(reset_data, done_idxs, validate_args=cfg.buffer.validate_args)
+                rb_add(reset_data, done_idxs, validate_args=cfg.buffer.validate_args)
                 step_data["rewards"][:, done_idxs] = 0.0
                 step_data["terminated"][:, done_idxs] = 0.0
                 step_data["truncated"][:, done_idxs] = 0.0

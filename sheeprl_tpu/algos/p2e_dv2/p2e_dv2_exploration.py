@@ -46,8 +46,7 @@ from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EpisodeBuffer
-from sheeprl_tpu.data.prefetch import make_replay_prefetcher
-from sheeprl_tpu.utils.blocks import BlockDispatcher
+from sheeprl_tpu.data.device_buffer import make_device_replay
 from sheeprl_tpu.distributions import BernoulliSafeMode, Independent, Normal, OneHotCategorical
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -361,13 +360,6 @@ def main(ctx, cfg) -> None:
         params, opt_states, metrics = train_step(params, opt_states, batch, key, update_target)
         return (params, opt_states), metrics
 
-    dispatcher = BlockDispatcher(
-        _block_step,
-        cfg.algo.critic.per_rank_target_network_update_freq,
-        count_offset=0,
-        base_key=ctx.rng(),
-    )
-
     player_step = make_player_step(world_model, actor, actions_dim, is_continuous)
     player_jit = jax.jit(player_step, static_argnames=("greedy",))
     actor_type = cfg.algo.player.get("actor_type", "exploration")
@@ -387,6 +379,23 @@ def main(ctx, cfg) -> None:
 
     rb = make_buffer(cfg, num_envs, obs_keys, log_dir, rank, world)
     rb.seed(cfg.seed + rank)
+
+    # Device-vs-host replay data path, one shared implementation
+    # (data/device_buffer.py); the episode buffer type stays on host.
+    dispatcher, mirror, prefetcher, _run_block, rb_add = make_device_replay(
+        ctx,
+        cfg,
+        rb,
+        cnn_keys,
+        mlp_keys,
+        obs_space,
+        act_dim_sum,
+        _block_step,
+        dispatcher_kwargs=dict(
+            target_update_freq=cfg.algo.critic.per_rank_target_network_update_freq, count_offset=0
+        ),
+        require_sequential=True,
+    )
 
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
@@ -423,6 +432,8 @@ def main(ctx, cfg) -> None:
         learning_starts += start_iter
         if cfg.buffer.checkpoint and "rb" in state:
             rb.load_state_dict(state["rb"])
+            if mirror is not None:
+                mirror.load_from(rb)
 
     def _obs_row(o, idxs=None):
         row = {}
@@ -433,10 +444,6 @@ def main(ctx, cfg) -> None:
             v = np.asarray(o[k], dtype=np.float32) if idxs is None else np.asarray(o[k], dtype=np.float32)[idxs]
             row[k] = v.reshape(1, v.shape[0], -1)
         return row
-
-    # Double-buffered sampling: the next [G, T, B] block is drawn + shipped to the
-    # device while the current block's gradient steps execute (SURVEY §7).
-    prefetcher, rb_lock, _sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
     player_state = player_state_init(num_envs)
@@ -486,8 +493,7 @@ def main(ctx, cfg) -> None:
                     env_actions = np.stack([a.argmax(-1) for a in acts_np], -1)
 
             step_data["actions"] = stored_actions.reshape(1, num_envs, -1)
-            with rb_lock:
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            rb_add(step_data, validate_args=cfg.buffer.validate_args)
         env_time = time.perf_counter() - env_t0
 
         # Dispatch this iteration's gradient block BEFORE stepping the envs: the
@@ -499,13 +505,8 @@ def main(ctx, cfg) -> None:
                 (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
             )
             if grad_steps > 0:
-                sample = (
-                    prefetcher.get(grad_steps, stage_next=iter_num < num_iters)
-                    if prefetcher is not None
-                    else _sample_block(grad_steps)
-                )
-                params, opt_states = dispatcher.dispatch(
-                    (params, opt_states), sample, cumulative_grad_steps
+                params, opt_states = _run_block(
+                    (params, opt_states), grad_steps, cumulative_grad_steps, stage_next=iter_num < num_iters
                 )
                 cumulative_grad_steps += grad_steps
 
@@ -538,8 +539,7 @@ def main(ctx, cfg) -> None:
                 reset_data["truncated"] = step_data["truncated"][:, done_idxs]
                 reset_data["actions"] = np.zeros((1, len(done_idxs), act_dim_sum), np.float32)
                 reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-                with rb_lock:
-                    rb.add(reset_data, done_idxs, validate_args=cfg.buffer.validate_args)
+                rb_add(reset_data, done_idxs, validate_args=cfg.buffer.validate_args)
                 step_data["rewards"][:, done_idxs] = 0.0
                 step_data["terminated"][:, done_idxs] = 0.0
                 step_data["truncated"][:, done_idxs] = 0.0

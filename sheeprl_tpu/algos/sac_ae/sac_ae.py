@@ -27,6 +27,7 @@ from sheeprl_tpu.algos.sac_ae.agent import build_agent, preprocess_obs
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_buffer import DeviceReplayMirror, device_replay_enabled
 from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
 from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
@@ -90,6 +91,29 @@ def main(ctx, cfg) -> None:
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
     )
     rb.seed(cfg.seed + rank)
+
+    # Device-resident replay (buffer.device=True): SAC-AE rows carry BOTH obs and
+    # next-obs pixels, so the host path ships ~2× the Dreamer volume per batch —
+    # the HBM transition mirror removes that entirely (index-only sampling, in-jit
+    # [n, B] row gather).  The transition mirror is not shard_map'd, so the shared
+    # gate runs with allow_dp=False (DP falls back to the host prefetcher).
+    use_mirror = device_replay_enabled(ctx, cfg, allow_dp=False)
+    mirror = None
+    if use_mirror:
+        h, w = obs_space[cnn_keys[0]].shape[-2:]
+        c_total = sum(int(np.prod(obs_space[k].shape[:-2])) for k in cnn_keys)
+        mirror = DeviceReplayMirror(
+            rb.buffer_size,
+            num_envs,
+            {
+                "obs": ((c_total, h, w), jnp.uint8),
+                "next_obs": ((c_total, h, w), jnp.uint8),
+                "actions": ((act_dim,), jnp.float32),
+                "rewards": ((1,), jnp.float32),
+                "dones": ((1,), jnp.float32),
+            },
+        )
+
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
@@ -253,6 +277,16 @@ def main(ctx, cfg) -> None:
         learning_starts += start_iter
         if cfg.buffer.checkpoint and "rb" in state:
             rb.load_state_dict(state["rb"])
+            if mirror is not None and len(rb) > 0:
+                mirror.load_from_dense(
+                    {
+                        "obs": np.concatenate([rb[k] for k in cnn_keys], axis=2),
+                        "next_obs": np.concatenate([rb[f"next_{k}"] for k in cnn_keys], axis=2),
+                        "actions": rb["actions"],
+                        "rewards": rb["rewards"],
+                        "dones": rb["dones"],
+                    }
+                )
 
     def _img(o, idxs=None):
         parts = []
@@ -288,23 +322,43 @@ def main(ctx, cfg) -> None:
             batch_axis=1,
         )
 
-    if cfg.algo.get("async_prefetch", True):
+    if mirror is None and cfg.algo.get("async_prefetch", True):
         prefetcher = AsyncBatchPrefetcher(_sample_block)
         rb_lock = prefetcher.lock
     else:
         prefetcher, rb_lock = None, contextlib.nullcontext()
     futures = WindowedFutures()
 
+    transition_gather = mirror.make_transition_gather_fn() if mirror is not None else None
+
+    @jax.jit
+    def train_fn_indexed(p, o_state, mirror_arrays, idxs, envs_i, key, step0):
+        # In-jit [n, B] row gather from the HBM mirror, then the same scan.
+        batches = transition_gather(mirror_arrays, idxs, envs_i)
+        return train_fn(p, o_state, batches, key, step0)
+
     def _dispatch_train(grad_steps: int, stage_next: bool) -> None:
         nonlocal params, opt_state, cumulative_grad_steps
-        batches = (
-            prefetcher.get(grad_steps, stage_next=stage_next)
-            if prefetcher is not None
-            else _sample_block(grad_steps)
-        )
-        params, opt_state, train_metrics = train_fn(
-            params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
-        )
+        if mirror is not None:
+            idxs, envs_i = rb.sample_transition_idx(batch_size, grad_steps)
+            params, opt_state, train_metrics = train_fn_indexed(
+                params,
+                opt_state,
+                mirror.arrays,
+                jnp.asarray(idxs, jnp.int32),
+                jnp.asarray(envs_i, jnp.int32),
+                ctx.rng(),
+                jnp.asarray(cumulative_grad_steps),
+            )
+        else:
+            batches = (
+                prefetcher.get(grad_steps, stage_next=stage_next)
+                if prefetcher is not None
+                else _sample_block(grad_steps)
+            )
+            params, opt_state, train_metrics = train_fn(
+                params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+            )
         futures.track(train_metrics, grad_steps)
         cumulative_grad_steps += grad_steps
 
@@ -353,6 +407,18 @@ def main(ctx, cfg) -> None:
             step_data["actions"] = tanh_actions.astype(np.float32)[None]
             step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
             step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
+            if mirror is not None:
+                mirror.add(
+                    {
+                        "obs": np.concatenate([step_data[k] for k in cnn_keys], axis=2),
+                        "next_obs": np.concatenate([step_data[f"next_{k}"] for k in cnn_keys], axis=2),
+                        "actions": step_data["actions"],
+                        "rewards": step_data["rewards"],
+                        "dones": step_data["dones"],
+                    },
+                    list(range(num_envs)),
+                    [rb._pos] * num_envs,
+                )
             with rb_lock:
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
             obs = next_obs

@@ -212,6 +212,18 @@ class ReplayBuffer:
                 out[f"next_{k}"] = nxt.reshape(n_samples, batch_size, *arr.shape[2:])
         return out
 
+    def sample_transition_idx(self, batch_size: int, n_samples: int = 1) -> "Tuple[np.ndarray, np.ndarray]":
+        """Index-only analogue of :meth:`sample` (``sample_next_obs=False``) for the
+        device-resident mirror: the same uniform (row, env) distribution, returned
+        as ``[n_samples, batch_size]`` index arrays instead of data."""
+        if self.empty:
+            raise ValueError("No sample has been added to the buffer. Please add at least one via `add()`")
+        batch_dim = batch_size * n_samples
+        upper = self._buffer_size if self._full else self._pos
+        idxes = self._rng.integers(0, upper, size=batch_dim)
+        env_idxes = self._rng.integers(0, self._n_envs, size=batch_dim)
+        return idxes.reshape(n_samples, batch_size), env_idxes.reshape(n_samples, batch_size)
+
     def sample_tensors(
         self,
         batch_size: int,
@@ -482,13 +494,30 @@ class EnvIndependentReplayBuffer:
         samples = self.sample(batch_size=batch_size, sample_next_obs=sample_next_obs, n_samples=n_samples, **kwargs)
         return to_device(samples, dtype=dtype, sharding=sharding)
 
-    def sample_idx(self, batch_size: int, sequence_length: int) -> "Tuple[np.ndarray, np.ndarray]":
+    def sample_idx(
+        self, batch_size: int, sequence_length: int, env_range: Optional[Sequence[int]] = None
+    ) -> "Tuple[np.ndarray, np.ndarray]":
         """Index-only sequence sampling for the device-resident mirror
         (``data/device_buffer.py``): same env-split + start-validity distribution as
-        :meth:`sample`, but returns ``(env_ids [B], starts [B])`` instead of data."""
-        valid = [i for i, b in enumerate(self._buf) if len(b) > 0]
+        :meth:`sample`, but returns ``(env_ids [B], starts [B])`` instead of data.
+        ``env_range`` restricts the draw to a subset of envs (the sharded mirror
+        samples each data shard's own env block)."""
+        # Same eligibility conditions SequentialReplayBuffer.sample() enforces —
+        # bypassing them would surface as a raw numpy 'low >= high' in
+        # sample_start_idxes mid-run instead of a descriptive sampling error.
+        candidates = range(self._n_envs) if env_range is None else env_range
+        valid = [
+            i
+            for i in candidates
+            if (self._buf[i].full and sequence_length <= len(self._buf[i]))
+            or (not self._buf[i].full and self._buf[i]._pos - sequence_length + 1 >= 1)
+        ]
         if not valid:
-            raise ValueError("No sample has been added to the buffer.")
+            raise ValueError(
+                f"Cannot sample a sequence of length {sequence_length}: no env buffer "
+                f"in {list(candidates)} holds enough data "
+                f"(per-env sizes: {[len(b) for b in self._buf]})."
+            )
         env_ids = np.asarray(valid, np.intp)[self._rng.integers(0, len(valid), size=batch_size)]
         starts = np.empty(batch_size, np.intp)
         for i in np.unique(env_ids):

@@ -16,46 +16,75 @@ TPU-native answer: the replay rows live ON the device.
 * the host buffer stays the source of truth for checkpoint/resume; ``load_from``
   rebuilds the mirror after a resume.
 
+**Data parallelism**: with ``mesh.data > 1`` the ring's env axis is sharded over the
+``data`` mesh axis — each data shard owns a contiguous block of envs' rows.  Index
+sampling is per-shard (batch element ``j`` draws only from the envs its shard owns),
+so the in-jit gather is purely shard-local via ``shard_map``: no collective touches
+the ring, and the gathered ``[T, B]`` batch comes out sharded over ``data`` exactly
+like the host path's ``put_batch(..., batch_axis=1)`` batches.  Scatter writes are
+likewise shard-local (full-env masked updates).  This is what lets the flagship fast
+path compose with DP on a multi-chip host (the v4-8 north star) instead of falling
+back to host sampling.
+
 The mirror requires the whole buffer to fit in HBM next to the model: ~1.2 GB for
 the 100K-transition Atari-100K config — comfortable on any current TPU.  Enabled by
 ``buffer.device: True`` (the flagship default); loops fall back to host sampling +
-prefetch when disabled.
+prefetch when disabled (or multi-process — per-process mirrors would feed the SPMD
+program process-divergent index arrays, which JAX does not value-check).
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _scatter_rows_tree(
-    bufs: Dict[str, jax.Array], rows: Dict[str, jax.Array], envs: jax.Array, positions: jax.Array
-) -> Dict[str, jax.Array]:
-    """In-place ``bufs[k][positions[i], envs[i]] = rows[k][i]`` for every key in ONE
-    dispatch (donated — no ring copy; per-key calls would each pay the dispatch
-    overhead that dominates remote-TPU hosts)."""
-    return {k: bufs[k].at[positions, envs].set(rows[k]) for k in bufs}
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def gather_sequences(
-    mirror: Dict[str, jax.Array], envs: jax.Array, starts: jax.Array, sequence_length: int
+    mirror: Dict[str, jax.Array],
+    envs: jax.Array,
+    starts: jax.Array,
+    sequence_length: int,
+    row_shapes: Dict[str, Sequence[int]],
 ) -> Dict[str, jax.Array]:
-    """In-jit gather of ``[T, B, ...]`` sequences from ``[cap, n_envs, ...]`` rings.
+    """In-jit gather of ``[T, B, ...]`` sequences from ``[n_envs, cap, flat]`` rings.
 
     ``envs``/``starts``: ``[B]`` int32; rows wrap modulo capacity (the host-side
     index sampling guarantees wrapped sequences never cross the write cursor).
+    ``row_shapes`` restores each key's logical per-row shape after the gather
+    (rows are stored FLAT — see :class:`DeviceReplayMirror` for the layout
+    rationale).  Inside ``shard_map`` the same code runs on the shard-local ring
+    with shard-local env ids.
     """
     out = {}
     for k, buf in mirror.items():
-        cap = buf.shape[0]
+        cap = buf.shape[1]
         t_idx = (starts[:, None] + jnp.arange(sequence_length, dtype=starts.dtype)) % cap  # [B, T]
-        picked = buf[t_idx, envs[:, None]]  # [B, T, ...]
-        out[k] = jnp.swapaxes(picked, 0, 1)  # [T, B, ...]
+        picked = buf[envs[:, None], t_idx]  # [B, T, flat]
+        seq = jnp.swapaxes(picked, 0, 1)  # [T, B, flat]
+        out[k] = seq.reshape(sequence_length, envs.shape[0], *row_shapes[k])
+    return out
+
+
+def _masked_row_update(
+    bufs: Dict[str, jax.Array], rows: Dict[str, jax.Array], positions: jax.Array, mask: jax.Array
+) -> Dict[str, jax.Array]:
+    """``bufs[k][e, positions[e]] = rows[k][e]`` for every env ``e`` with
+    ``mask[e]``.  Unmasked envs are skipped by aiming their update OUT OF BOUNDS
+    (``mode="drop"``) — a PURE scatter, never reading the ring: a read-blend-write
+    formulation defeats the donation aliasing and doubles the ring's HBM footprint
+    at compile time.  One aligned update per env also keeps the scatter local to
+    the env shard under ``shard_map`` — a sparse scatter over an env subset would
+    make GSPMD reshard the ring."""
+    out = {}
+    for k, buf in bufs.items():
+        cap = buf.shape[1]
+        env_ar = jnp.arange(buf.shape[0], dtype=positions.dtype)
+        pos = jnp.where(mask, positions, cap)  # cap = out of bounds -> dropped
+        out[k] = buf.at[env_ar, pos].set(rows[k], mode="drop")
     return out
 
 
@@ -64,15 +93,65 @@ class DeviceReplayMirror:
 
     ``specs``: ``{key: (shape, dtype)}`` per-row (no leading axes).  All write
     positions are tracked by the caller (the host buffer's per-env cursors).
+
+    **Storage layout** (TPU-critical): rows are stored FLAT and env-leading —
+    ``[n_envs, capacity, prod(shape)]``.  TPU arrays are tiled on their last two
+    dims ((8,128) f32 / (32,128) u8); the naive ``[cap, n_envs, C, H, W]`` layout
+    pads 64-wide pixel rows 2× and ``[cap, n_envs, 1]`` scalar rings up to 256×,
+    which blows a 6 GB Atari-scale ring past chip HBM at compile time.  With the
+    flat layout the last two dims are ``(capacity, flat)`` — both large and
+    tile-aligned, ~zero padding.  Gathers reshape back to the logical row shape
+    in-jit (free).
+
+    ``mesh``/``dp``: when ``dp > 1`` the leading env axis is sharded over the
+    mesh's ``data`` axis (``n_envs % dp == 0`` required); scatter and gather run
+    shard-local via ``shard_map``.
     """
 
-    def __init__(self, capacity: int, n_envs: int, specs: Dict[str, Tuple[Sequence[int], Any]]):
+    def __init__(
+        self,
+        capacity: int,
+        n_envs: int,
+        specs: Dict[str, Tuple[Sequence[int], Any]],
+        mesh=None,
+        dp: int = 1,
+    ):
         self.capacity = int(capacity)
         self.n_envs = int(n_envs)
         self.specs = dict(specs)
+        self.dp = int(dp) if mesh is not None else 1
+        self.mesh = mesh if self.dp > 1 else None
+        if self.dp > 1 and self.n_envs % self.dp != 0:
+            raise ValueError(
+                f"the data axis ({dp}) must divide n_envs={n_envs} for an env-sharded mirror"
+            )
+        self.env_sharding = NamedSharding(self.mesh, P("data")) if self.dp > 1 else None
+        self._flat = {k: int(np.prod(shape)) for k, (shape, dtype) in specs.items()}
+        self._row_shapes = {k: tuple(shape) for k, (shape, dtype) in specs.items()}
+        # rings are placed straight into their final (possibly env-sharded) layout
+        # from host zeros — building them on-device first would transiently
+        # allocate the full unsharded ring on device 0
         self.arrays: Dict[str, jax.Array] = {
-            k: jnp.zeros((self.capacity, self.n_envs, *shape), dtype) for k, (shape, dtype) in specs.items()
+            k: self._device(np.zeros((self.n_envs, self.capacity, self._flat[k]), np.dtype(dtype)))
+            for k, (shape, dtype) in specs.items()
         }
+        self._scatter = self._make_scatter()
+
+    def _device(self, x):
+        # always commits to device: a host ndarray left in ``arrays`` would be
+        # re-uploaded by every subsequent jitted dispatch
+        return jax.device_put(x, self.env_sharding) if self.env_sharding is not None else jax.device_put(x)
+
+    def _make_scatter(self):
+        if self.dp <= 1:
+            return jax.jit(_masked_row_update, donate_argnums=(0,))
+        fn = jax.shard_map(
+            _masked_row_update,
+            mesh=self.mesh,
+            in_specs=(P("data"), P("data"), P("data"), P("data")),
+            out_specs=P("data"),
+        )
+        return jax.jit(fn, donate_argnums=(0,))
 
     @property
     def nbytes(self) -> int:
@@ -81,36 +160,103 @@ class DeviceReplayMirror:
     def add(self, data: Dict[str, np.ndarray], envs: Sequence[int], positions: Sequence[int]) -> None:
         """Scatter one row per selected env: ``data[k]`` is ``[1, len(envs), ...]``
         (the loops' step_data layout); ``positions[i]`` is env ``envs[i]``'s write
-        cursor BEFORE the host add.  Static shapes: pad to ``n_envs`` by repeating
-        the first target (idempotent duplicate write)."""
-        n = len(envs)
-        pad = self.n_envs - n
-        env_arr = np.asarray(list(envs) + [envs[0]] * pad, np.int32)
-        pos_arr = np.asarray([p % self.capacity for p in positions] + [positions[0] % self.capacity] * pad, np.int32)
+        cursor BEFORE the host add.  The update ships a full ``[n_envs]``-aligned
+        row block with a write mask (static shapes, shard-local under dp>1);
+        unselected envs are masked no-ops."""
+        env_sel = np.asarray(envs, np.intp)
+        mask = np.zeros(self.n_envs, bool)
+        mask[env_sel] = True
+        pos_arr = np.zeros(self.n_envs, np.int32)
+        pos_arr[env_sel] = np.asarray(positions, np.int64) % self.capacity
         row_tree = {}
         for k in self.arrays:
-            rows = np.asarray(data[k])[0]  # [n, ...]
-            if pad:
-                rows = np.concatenate([rows, np.repeat(rows[:1], pad, axis=0)], 0)
-            row_tree[k] = rows.reshape(self.n_envs, *self.specs[k][0]).astype(self.specs[k][1])
-        self.arrays = _scatter_rows_tree(self.arrays, row_tree, env_arr, pos_arr)
+            _, dtype = self.specs[k]
+            rows = np.zeros((self.n_envs, self._flat[k]), dtype)
+            rows[env_sel] = np.asarray(data[k])[0].reshape(len(env_sel), self._flat[k])
+            row_tree[k] = rows
+        self.arrays = self._scatter(self.arrays, row_tree, pos_arr, mask)
 
     def load_from(self, host_rb) -> None:
         """Rebuild the mirror from an ``EnvIndependentReplayBuffer`` (resume path):
-        one bulk transfer per key."""
+        one bulk transfer per key, placed with the mirror's sharding."""
         for k in self.arrays:
             host = np.zeros(self.arrays[k].shape, self.specs[k][1])
             for e, sub in enumerate(host_rb.buffer):
                 arr = np.asarray(sub._buf[k])  # [cap, 1, ...]
                 rows = min(arr.shape[0], self.capacity)
-                host[:rows, e] = arr[:rows, 0].reshape(rows, *self.specs[k][0])
-            self.arrays[k] = jax.device_put(host)
+                host[e, :rows] = arr[:rows, 0].reshape(rows, self._flat[k])
+            self.arrays[k] = self._device(host)
+
+    def load_from_dense(self, host_arrays: Dict[str, np.ndarray]) -> None:
+        """Rebuild from dense ``[cap, n_envs, ...]`` host arrays — the resume path
+        for loops built on the plain :class:`~sheeprl_tpu.data.buffers.ReplayBuffer`
+        (SAC-AE), whose storage is already mirror-shaped."""
+        for k in self.arrays:
+            src = np.asarray(host_arrays[k])
+            rows = min(src.shape[0], self.capacity)
+            host = np.zeros(self.arrays[k].shape, self.specs[k][1])
+            host[:, :rows] = np.moveaxis(src[:rows].reshape(rows, self.n_envs, self._flat[k]), 0, 1)
+            self.arrays[k] = self._device(host)
+
+    def make_gather_fn(self, sequence_length: int):
+        """The in-jit batch gather for :class:`~sheeprl_tpu.utils.blocks.
+        IndexedBlockDispatcher`.  ``dp > 1``: shard-local gather via ``shard_map``
+        — batch element ``j`` lives on the shard owning env ``envs[j]`` (the
+        sharded sampler guarantees the alignment), and global env ids reduce to
+        local ones by ``% E_local`` because each shard owns a contiguous env
+        block.  Output ``[T, B, ...]`` is sharded over ``data`` on the batch axis,
+        identical to the host path's ``put_batch(..., batch_axis=1)``."""
+        shapes = self._row_shapes
+        if self.dp <= 1:
+            return lambda m, e, s: gather_sequences(m, e, s, sequence_length, row_shapes=shapes)
+        e_local = self.n_envs // self.dp
+
+        def local_gather(mirror, envs, starts):
+            return gather_sequences(mirror, envs % e_local, starts, sequence_length, row_shapes=shapes)
+
+        return jax.shard_map(
+            local_gather,
+            mesh=self.mesh,
+            in_specs=(P("data"), P("data"), P("data")),
+            out_specs=P(None, "data"),
+        )
+
+    def make_transition_gather_fn(self):
+        """In-jit ``[n, B]`` transition-row gather (SAC-AE's batch shape): returns
+        ``closure(mirror_arrays, idxs, envs) -> {key: [n, B, *row_shape]}``.
+        Single-chip (the transition mirror is not sharded)."""
+        shapes = self._row_shapes
+
+        def gather(mirror, idxs, envs):
+            out = {}
+            for k, buf in mirror.items():
+                picked = buf[envs, idxs]  # [n, B, flat]
+                out[k] = picked.reshape(*idxs.shape, *shapes[k])
+            return out
+
+        return gather
+
+    def host_rows(self, key: str) -> np.ndarray:
+        """Fetch ring ``key`` as ``[cap, n_envs, *row_shape]`` numpy (test/debug
+        accessor for the logical layout)."""
+        arr = np.asarray(jax.device_get(self.arrays[key]))  # [n_envs, cap, flat]
+        return np.moveaxis(arr, 0, 1).reshape(self.capacity, self.n_envs, *self._row_shapes[key])
 
 
-def device_replay_enabled(ctx, cfg, require_sequential: bool = False) -> bool:
-    """The ``buffer.device`` gate shared by the Dreamer loops: single-chip only
-    (the mirror is not sharded) and — for DV2 — sequential buffers only.  Every
-    fallback logs why, so a requested device buffer never degrades silently."""
+def device_replay_enabled(ctx, cfg, require_sequential: bool = False, allow_dp: bool = True) -> bool:
+    """The ``buffer.device`` gate shared by every device-replay consumer.  Every
+    fallback logs why, so a requested device buffer never degrades silently.
+    Requirements:
+
+    * single process — per-process mirrors would sample process-divergent index
+      arrays and feed them to the SPMD train block, which JAX does not
+      value-check (silent replica divergence);
+    * for DV2, sequential buffers only (the episode buffer stays on host);
+    * under data parallelism, ``num_envs`` and the batch size must divide the
+      ``data`` axis so the env-sharded ring and the per-shard sampler line up —
+      or, for loops whose mirror is not sharded (``allow_dp=False``, SAC-AE's
+      transition mirror), any ``data > 1`` falls back.
+    """
     import logging
 
     if not bool(cfg.buffer.get("device", False)):
@@ -122,10 +268,29 @@ def device_replay_enabled(ctx, cfg, require_sequential: bool = False) -> bool:
             "buffer stays on host); falling back to host sampling."
         )
         return False
-    if ctx.data_parallel_size > 1:
+    if jax.process_count() > 1:
         log.warning(
-            "buffer.device=True is single-chip only (the mirror is not sharded); "
-            "falling back to host-side sampling with the async prefetcher."
+            "buffer.device=True is single-process only (per-process mirrors would "
+            "feed the SPMD program divergent index arrays); falling back to "
+            "host-side sampling with the async prefetcher."
+        )
+        return False
+    if not allow_dp and ctx.data_parallel_size > 1:
+        log.warning(
+            "buffer.device=True is single-chip for this algorithm (its mirror is "
+            "not sharded); falling back to host-side sampling with the async "
+            "prefetcher."
+        )
+        return False
+    dp = ctx.data_parallel_size
+    if dp > 1 and (cfg.env.num_envs % dp != 0 or cfg.algo.per_rank_batch_size % dp != 0):
+        log.warning(
+            "buffer.device=True with mesh.data=%d needs env.num_envs (%d) and "
+            "algo.per_rank_batch_size (%d) to divide the data axis; falling back "
+            "to host-side sampling.",
+            dp,
+            cfg.env.num_envs,
+            cfg.algo.per_rank_batch_size,
         )
         return False
     return True
@@ -147,11 +312,27 @@ def make_rb_add(mirror: Optional[DeviceReplayMirror], rb, rb_lock, num_envs: int
     return rb_add
 
 
-def sample_index_block(rb, batch_size: int, sequence_length: int, n: int):
+def sample_index_block(rb, batch_size: int, sequence_length: int, n: int, dp: int = 1):
     """``n`` gradient steps' worth of (env, start) index pairs as ``[n, B]`` arrays
-    for :class:`~sheeprl_tpu.utils.blocks.IndexedBlockDispatcher`."""
-    idx = [rb.sample_idx(batch_size, sequence_length) for _ in range(n)]
-    return np.stack([e for e, _ in idx]), np.stack([s for _, s in idx])
+    for :class:`~sheeprl_tpu.utils.blocks.IndexedBlockDispatcher`.
+
+    ``dp > 1``: the batch is drawn per data shard — element ``j`` (in shard
+    ``j // (B//dp)``) samples only from the env block that shard owns, so the
+    sharded gather never crosses shards.
+    """
+    if dp <= 1:
+        idx = [rb.sample_idx(batch_size, sequence_length) for _ in range(n)]
+        return np.stack([e for e, _ in idx]), np.stack([s for _, s in idx])
+    e_local = rb.n_envs // dp
+    b_local = batch_size // dp
+    envs = np.empty((n, batch_size), np.intp)
+    starts = np.empty((n, batch_size), np.intp)
+    for g in range(n):
+        for s in range(dp):
+            e, st = rb.sample_idx(b_local, sequence_length, env_range=range(s * e_local, (s + 1) * e_local))
+            envs[g, s * b_local : (s + 1) * b_local] = e
+            starts[g, s * b_local : (s + 1) * b_local] = st
+    return envs, starts
 
 
 def make_device_replay(
@@ -169,13 +350,19 @@ def make_device_replay(
     """One-stop wiring for the Dreamer-family loops — the single implementation of
     the device-vs-host replay data path.
 
-    Returns ``(dispatcher, mirror, prefetcher, rb_lock, sample_block, rb_add)``:
+    Returns ``(dispatcher, mirror, prefetcher, run_block, rb_add)``:
 
-    * device path (``buffer.device=True``, single chip): an
+    * device path (``buffer.device=True``, single process): an
       :class:`~sheeprl_tpu.utils.blocks.IndexedBlockDispatcher` gathering from the
-      HBM mirror in-jit; no prefetcher (sampling is index-only);
+      HBM mirror in-jit (env-sharded over ``data`` when ``mesh.data > 1``), fed
+      index-only sampling; no prefetcher;
     * host path: a :class:`~sheeprl_tpu.utils.blocks.BlockDispatcher` fed by the
       async double-buffered prefetcher.
+
+    ``run_block(carry, n, start_count, stage_next=True)`` runs one iteration's
+    ``n``-step gradient block through whichever path is active and returns the new
+    carry — the ONE place the mirror-vs-host dispatch logic lives (the loops just
+    call it).
 
     ``step_fn``/``dispatcher_kwargs`` are the loop's per-step train closure and its
     cadence options (``target_update_freq``, ``count_offset``); call AFTER the
@@ -199,26 +386,36 @@ def make_device_replay(
             mlp_keys,
             obs_space,
             [("actions", act_dim_sum), ("rewards", 1), ("terminated", 1), ("truncated", 1), ("is_first", 1)],
+            ctx=ctx,
         )
-        dispatcher = IndexedBlockDispatcher(
-            step_fn,
-            gather_fn=lambda m, e, s: gather_sequences(m, e, s, seq_len),
-            **kwargs,
-        )
-        prefetcher, rb_lock, sample_block = None, contextlib.nullcontext(), None
+        dispatcher = IndexedBlockDispatcher(step_fn, gather_fn=mirror.make_gather_fn(seq_len), **kwargs)
+        prefetcher, rb_lock = None, contextlib.nullcontext()
+        dp = mirror.dp
+
+        def run_block(carry, n: int, start_count: int, stage_next: bool = True):
+            envs_idx, starts_idx = sample_index_block(rb, batch_size, seq_len, n, dp=dp)
+            return dispatcher.dispatch(carry, mirror.arrays, envs_idx, starts_idx, start_count)
+
     else:
         mirror = None
         dispatcher = BlockDispatcher(step_fn, **kwargs)
         prefetcher, rb_lock, sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
 
+        def run_block(carry, n: int, start_count: int, stage_next: bool = True):
+            sample = prefetcher.get(n, stage_next=stage_next) if prefetcher is not None else sample_block(n)
+            return dispatcher.dispatch(carry, sample, start_count)
+
+    # rb_lock stays internal: rb_add (below) and the prefetcher's sampler are the
+    # only buffer accessors, so the loops never need to lock rb themselves.
     rb_add = make_rb_add(mirror, rb, rb_lock, rb.n_envs)
-    return dispatcher, mirror, prefetcher, rb_lock, sample_block, rb_add
+    return dispatcher, mirror, prefetcher, run_block, rb_add
 
 
-def make_mirror_for(rb, cnn_keys, mlp_keys, obs_space, extra_float_keys) -> DeviceReplayMirror:
+def make_mirror_for(rb, cnn_keys, mlp_keys, obs_space, extra_float_keys, ctx=None) -> DeviceReplayMirror:
     """Build a mirror matching the Dreamer loops' row layout (``_obs_row``): pixel
     keys are stored ``[C_total, H, W]`` uint8 (decoded to float on device inside
-    the train step), vector keys flat float32, scalar keys float32 ``[dim]``."""
+    the train step), vector keys flat float32, scalar keys float32 ``[dim]``.
+    With a ``ctx`` whose mesh has ``data > 1``, the ring is env-sharded over it."""
     specs: Dict[str, Tuple[Sequence[int], Any]] = {}
     for k in cnn_keys:
         shape = obs_space[k].shape
@@ -227,4 +424,6 @@ def make_mirror_for(rb, cnn_keys, mlp_keys, obs_space, extra_float_keys) -> Devi
         specs[k] = ((int(np.prod(obs_space[k].shape)),), jnp.float32)
     for k, dim in extra_float_keys:
         specs[k] = ((int(dim),), jnp.float32)
-    return DeviceReplayMirror(rb.buffer_size, rb.n_envs, specs)
+    mesh = ctx.mesh if ctx is not None and ctx.data_parallel_size > 1 else None
+    dp = ctx.data_parallel_size if ctx is not None else 1
+    return DeviceReplayMirror(rb.buffer_size, rb.n_envs, specs, mesh=mesh, dp=dp)

@@ -65,6 +65,8 @@ class MeshContext:
     seed: int = 42
     _rng_key: Optional[jax.Array] = field(default=None, repr=False)
     _local_rng_key: Optional[jax.Array] = field(default=None, repr=False)
+    _rng_buf: list = field(default_factory=list, repr=False)
+    _local_rng_buf: list = field(default_factory=list, repr=False)
     _warned_replication: bool = field(default=False, repr=False)
 
     # -- topology -----------------------------------------------------------
@@ -242,7 +244,7 @@ class MeshContext:
     _RNG_BATCH = 64
 
     def _draw(self, chain_attr: str, buf_attr: str, seed_fn) -> jax.Array:
-        buf = getattr(self, buf_attr, None)
+        buf = getattr(self, buf_attr)
         if not buf:
             chain = getattr(self, chain_attr)
             if chain is None:

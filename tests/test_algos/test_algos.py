@@ -113,11 +113,19 @@ DV3_ARGS = [
 ]
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    [
+        "discrete_dummy",
+        pytest.param("multidiscrete_dummy", marks=pytest.mark.slow),
+        pytest.param("continuous_dummy", marks=pytest.mark.slow),
+    ],
+)
 def test_dreamer_v3_dummy_envs(tmp_path, env_id):
     run(DV3_ARGS + [f"env={env_id}"] + standard_args(tmp_path, extra=["dry_run=False"]))
 
 
+@pytest.mark.slow
 def test_dreamer_v3_resume_and_evaluate(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
@@ -198,6 +206,7 @@ def test_dreamer_v3_device_buffer(tmp_path):
     assert _ckpts(tmp_path), "no checkpoint written"
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("algo", ["dreamer_v1", "dreamer_v2"])
 def test_dreamer_v12_device_buffer(tmp_path, algo):
     """buffer.device=True on the DV1/DV2 loops (same HBM-resident replay path as
@@ -213,6 +222,34 @@ def test_dreamer_v12_device_buffer(tmp_path, algo):
         ]
         + standard_args(tmp_path, extra=["dry_run=False"])
     )
+    assert _ckpts(tmp_path), "no checkpoint written"
+
+
+@pytest.mark.slow
+def test_dreamer_v3_device_buffer_data_parallel(tmp_path, caplog):
+    """buffer.device=True composed with mesh.data=2: the replay ring is env-sharded
+    over the data axis (per-shard sampling + shard_map gather) instead of falling
+    back to host sampling — the r4 DP-composable fast path."""
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="sheeprl_tpu.data.device_buffer"):
+        run(
+            [
+                "exp=dreamer_v3_dummy",
+                "env=discrete_dummy",
+                "buffer.device=True",
+                "mesh.devices=2",
+                "algo.total_steps=32",
+                "algo.learning_starts=16",
+            ]
+            + standard_args(tmp_path, extra=["dry_run=False"])
+        )
+    fallbacks = [
+        r
+        for r in caplog.records
+        if r.name == "sheeprl_tpu.data.device_buffer" and "falling back" in r.getMessage()
+    ]
+    assert not fallbacks, "device replay fell back to host sampling under data parallelism"
     assert _ckpts(tmp_path), "no checkpoint written"
 
 
@@ -289,7 +326,14 @@ DV2_ARGS = [
 ]
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    [
+        "discrete_dummy",
+        pytest.param("multidiscrete_dummy", marks=pytest.mark.slow),
+        pytest.param("continuous_dummy", marks=pytest.mark.slow),
+    ],
+)
 def test_dreamer_v2_dummy_envs(tmp_path, env_id):
     run(DV2_ARGS + [f"env={env_id}"] + standard_args(tmp_path, extra=["dry_run=False"]))
 
@@ -304,6 +348,7 @@ def test_dreamer_v2_episode_buffer(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_dreamer_v2_resume_and_evaluate(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
@@ -325,11 +370,19 @@ DV1_ARGS = [
 ]
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id",
+    [
+        "discrete_dummy",
+        pytest.param("multidiscrete_dummy", marks=pytest.mark.slow),
+        pytest.param("continuous_dummy", marks=pytest.mark.slow),
+    ],
+)
 def test_dreamer_v1_dummy_envs(tmp_path, env_id):
     run(DV1_ARGS + [f"env={env_id}"] + standard_args(tmp_path, extra=["dry_run=False"]))
 
 
+@pytest.mark.slow
 def test_dreamer_v1_resume_and_evaluate(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
@@ -351,11 +404,14 @@ P2E_DV3_ARGS = [
 ]
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+@pytest.mark.parametrize(
+    "env_id", ["discrete_dummy", pytest.param("continuous_dummy", marks=pytest.mark.slow)]
+)
 def test_p2e_dv3_exploration_dummy_envs(tmp_path, env_id):
     run(P2E_DV3_ARGS + [f"env={env_id}"] + standard_args(tmp_path, extra=["dry_run=False"]))
 
 
+@pytest.mark.slow
 def test_p2e_dv3_finetuning_from_exploration(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
@@ -386,6 +442,57 @@ def test_p2e_dv3_finetuning_from_exploration(tmp_path):
     evaluate([f"checkpoint_path={fntn_ckpts[-1]}", "env.capture_video=False"])
 
 
+@pytest.mark.slow
+def test_p2e_dv3_device_buffer_exploration_and_finetuning(tmp_path):
+    """buffer.device=True on the P2E-DV3 loops: the exploration loop trains off the
+    HBM mirror, and the finetuning loop REBUILDS the mirror from the exploration
+    buffer hand-off (mirror.load_from) before its first gradient step."""
+    dev = ["buffer.device=True", "mesh.devices=1"]
+    run(P2E_DV3_ARGS + ["env=discrete_dummy"] + dev + standard_args(tmp_path, extra=["dry_run=False"]))
+    ckpts = _ckpts(tmp_path)
+    assert ckpts
+    run(
+        P2E_DV3_ARGS
+        + [
+            "env=discrete_dummy",
+            "algo.name=p2e_dv3_finetuning",
+            f"checkpoint.exploration_ckpt_path={ckpts[-1]}",
+            "buffer.load_from_exploration=True",
+            "algo.total_steps=48",
+        ]
+        + dev
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    assert len(_ckpts(tmp_path)) > len(ckpts)
+
+
+def test_sac_ae_device_buffer(tmp_path):
+    """buffer.device=True on SAC-AE: HBM transition mirror (obs+next_obs rows),
+    index-only sampling, in-jit row gather."""
+    run(
+        [
+            "exp=sac_ae",
+            "env=continuous_dummy",
+            "env.screen_size=32",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.encoder.features_dim=8",
+            "algo.encoder.channels=4",
+            "algo.actor.dense_units=8",
+            "algo.critic.dense_units=8",
+            "algo.per_rank_batch_size=4",
+            "algo.learning_starts=4",
+            "algo.total_steps=16",
+            "buffer.size=256",
+            "buffer.device=True",
+            "mesh.devices=1",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    assert _ckpts(tmp_path), "no checkpoint written"
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("base", ["p2e_dv1", "p2e_dv2"])
 def test_p2e_dv12_exploration_and_finetuning(tmp_path, base):
     from sheeprl_tpu.cli import evaluate
@@ -511,6 +618,7 @@ def test_module_launchers_wired(tmp_path):
         assert needle in blob, f"{mod} did not print its usage hint: {blob[-500:]}"
 
 
+@pytest.mark.slow
 def test_dreamer_v3_memmap_buffer_resume(tmp_path):
     """E2E with disk-backed (memmap) replay buffers + checkpoint + resume: the
     reference's default buffer mode (buffer.memmap=True) was only unit-tested; this

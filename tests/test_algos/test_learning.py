@@ -16,6 +16,9 @@ import pytest
 
 from sheeprl_tpu.cli import run
 
+# Learning-to-reward runs take minutes each — slow tier (run with -m slow).
+pytestmark = pytest.mark.slow
+
 
 def _tb_scalar(log_root, tag):
     from tensorboard.backend.event_processing.event_accumulator import EventAccumulator

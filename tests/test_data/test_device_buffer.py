@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.device_buffer import DeviceReplayMirror, gather_sequences
+from sheeprl_tpu.data.device_buffer import DeviceReplayMirror
 
 
 def _row(rng, n_envs, t):
@@ -40,14 +40,14 @@ def test_mirror_matches_host_rows():
 
     # Every mirror row must equal the host row at the same (pos, env).
     for k in ("rgb", "rewards"):
-        dev = np.asarray(jax.device_get(mirror.arrays[k]))
+        dev = mirror.host_rows(k)
         for e in range(n_envs):
             host = np.asarray(rb.buffer[e]._buf[k])[:, 0].reshape(cap, *dev.shape[2:])
             np.testing.assert_array_equal(dev[:, e], host, err_msg=f"{k} env {e}")
 
     # Index-sampled device gather == host rows at those indices.
     envs, starts = rb.sample_idx(8, seq)
-    out = jax.jit(lambda m, e, s: gather_sequences(m, e, s, seq))(
+    out = jax.jit(mirror.make_gather_fn(seq))(
         mirror.arrays, jnp.asarray(envs, jnp.int32), jnp.asarray(starts, jnp.int32)
     )
     for b in range(8):
@@ -55,6 +55,55 @@ def test_mirror_matches_host_rows():
         host = np.asarray(rb.buffer[e]._buf["rewards"])[:, 0]
         expect = np.stack([host[(st + t) % cap] for t in range(seq)])
         np.testing.assert_array_equal(np.asarray(out["rewards"])[:, b], expect)
+
+
+def test_sharded_mirror_parity_with_host():
+    """dp>1 (env axis sharded over the CPU mesh's data axis): scatter, per-shard
+    index sampling, and the shard_map gather must reproduce exactly what the host
+    buffer would sample — the device path ≡ host path contract under DP."""
+    from sheeprl_tpu.data.device_buffer import sample_index_block
+    from sheeprl_tpu.parallel.mesh import build_mesh
+
+    dp, n_envs, cap, seq, batch = 4, 8, 16, 4, 8
+    mesh = build_mesh(data=dp, devices=jax.devices()[:dp])
+    rng = np.random.default_rng(2)
+    rb = EnvIndependentReplayBuffer(cap, n_envs=n_envs, obs_keys=("rgb",), buffer_cls=SequentialReplayBuffer)
+    rb.seed(2)
+    mirror = DeviceReplayMirror(cap, n_envs, _specs(), mesh=mesh, dp=dp)
+
+    for t in range(25):  # wraps the ring
+        row = _row(rng, n_envs, t)
+        positions = [rb.buffer[e]._pos for e in range(n_envs)]
+        mirror.add(row, list(range(n_envs)), positions)
+        rb.add(row)
+        if t % 7 == 3:  # subset writes with per-env cursors diverging
+            sub = {k: v[:, :1] for k, v in _row(rng, n_envs, 100 + t).items()}
+            mirror.add(sub, [5], [rb.buffer[5]._pos])
+            rb.add(sub, indices=[5])
+
+    for k in ("rgb", "rewards"):
+        dev = mirror.host_rows(k)
+        for e in range(n_envs):
+            host = np.asarray(rb.buffer[e]._buf[k])[:, 0].reshape(cap, *dev.shape[2:])
+            np.testing.assert_array_equal(dev[:, e], host, err_msg=f"{k} env {e}")
+
+    # Per-shard sampling keeps batch element j on the shard owning env j's block...
+    envs, starts = sample_index_block(rb, batch, seq, n=3, dp=dp)
+    e_local, b_local = n_envs // dp, batch // dp
+    for g in range(3):
+        for j in range(batch):
+            assert envs[g, j] // e_local == j // b_local
+
+    # ...so the shard_map gather is shard-local and matches the host rows.
+    gather = jax.jit(mirror.make_gather_fn(seq))
+    out = gather(mirror.arrays, jnp.asarray(envs[0], jnp.int32), jnp.asarray(starts[0], jnp.int32))
+    assert out["rewards"].sharding.spec == jax.sharding.PartitionSpec(None, "data")
+    for b in range(batch):
+        e, st = int(envs[0][b]), int(starts[0][b])
+        for k in ("rgb", "rewards"):
+            host = np.asarray(rb.buffer[e]._buf[k])[:, 0].reshape(cap, *np.asarray(out[k]).shape[2:])
+            expect = np.stack([host[(st + t) % cap] for t in range(seq)])
+            np.testing.assert_array_equal(np.asarray(out[k])[:, b], expect, err_msg=f"{k} b={b}")
 
 
 def test_mirror_load_from_resume():
@@ -65,6 +114,6 @@ def test_mirror_load_from_resume():
         rb.add(_row(rng, n_envs, t))
     mirror = DeviceReplayMirror(cap, n_envs, _specs())
     mirror.load_from(rb)
-    dev = np.asarray(jax.device_get(mirror.arrays["rewards"]))
+    dev = mirror.host_rows("rewards")
     for e in range(n_envs):
         np.testing.assert_array_equal(dev[:5, e, 0], np.arange(5, dtype=np.float32))

@@ -12,6 +12,11 @@ import sys
 import textwrap
 from pathlib import Path
 
+import pytest
+
+# Each test spawns 2 JAX processes that re-compile everything — slow tier.
+pytestmark = pytest.mark.slow
+
 REPO = Path(__file__).resolve().parents[2]
 
 CHILD = textwrap.dedent(
@@ -110,6 +115,47 @@ def test_two_process_multihost(tmp_path):
     _run_two_children(CHILD, tmp_path, timeout=300, ok_marker="child")
 
 
+# Per-rank param fingerprint at every checkpoint: the r2 multihost RNG bug's failure
+# mode was SILENT replica divergence — liveness checks (ckpt exists, events exist)
+# would still pass.  Each rank hashes the params object IT passes to
+# CheckpointManager.save; the parent asserts the ranks' hashes are bit-identical.
+HASH_CAPTURE = textwrap.dedent(
+    """
+    import hashlib
+    import numpy as _np
+    from sheeprl_tpu.checkpoint import manager as _mgr
+
+    _orig_save = _mgr.CheckpointManager.save
+
+    def _capture_save(self, step, state):
+        flat, _ = jax.tree.flatten(jax.device_get(state["params"]))
+        h = hashlib.sha256()
+        for a in flat:
+            h.update(_np.ascontiguousarray(a).tobytes())
+        with open(f"{tmp}/params_hash_rank{pid}_step{step}.txt", "w") as f:
+            f.write(h.hexdigest())
+        return _orig_save(self, step, state)
+
+    _mgr.CheckpointManager.save = _capture_save
+    """
+)
+
+
+def _assert_rank_params_identical(tmp_path):
+    """Pair up the per-rank hash files by step and require bit-identical params."""
+    hashes = {}
+    for f in tmp_path.glob("params_hash_rank*_step*.txt"):
+        rank, step = f.stem.replace("params_hash_rank", "").split("_step")
+        hashes.setdefault(step, {})[rank] = f.read_text()
+    assert hashes, "no per-rank param hashes captured"
+    for step, by_rank in hashes.items():
+        assert len(by_rank) == 2, f"step {step}: only ranks {list(by_rank)} hashed"
+        assert by_rank["0"] == by_rank["1"], (
+            f"step {step}: per-rank params DIVERGED (rank0 {by_rank['0'][:12]}… != "
+            f"rank1 {by_rank['1'][:12]}…) — the SPMD replicas are no longer identical"
+        )
+
+
 TRAIN_CHILD = textwrap.dedent(
     """
     import os, sys
@@ -122,6 +168,8 @@ TRAIN_CHILD = textwrap.dedent(
     coordinator, pid, tmp = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     sys.path.insert(0, {repo!r})
     from sheeprl_tpu.cli import run
+
+    HASH_CAPTURE
 
     run([
         "exp=dreamer_v3_dummy",
@@ -142,7 +190,7 @@ TRAIN_CHILD = textwrap.dedent(
     ])
     print(f"train child {{pid}} OK", flush=True)
     """
-).format(repo=str(REPO))
+).format(repo=str(REPO)).replace("HASH_CAPTURE", HASH_CAPTURE)
 
 
 def test_two_process_dreamer_v3_training(tmp_path):
@@ -155,6 +203,7 @@ def test_two_process_dreamer_v3_training(tmp_path):
     assert ckpts, "no checkpoint written by the 2-process run"
     events = sorted((tmp_path / "logs").rglob("events.out.tfevents.*"))
     assert events, "rank 0 wrote no tensorboard events"
+    _assert_rank_params_identical(tmp_path)
 
 
 DECOUPLED_CHILD = textwrap.dedent(
@@ -169,6 +218,8 @@ DECOUPLED_CHILD = textwrap.dedent(
     coordinator, pid, tmp = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     sys.path.insert(0, {repo!r})
     from sheeprl_tpu.cli import run
+
+    HASH_CAPTURE
 
     run([
         "exp=ppo_decoupled",
@@ -195,7 +246,7 @@ DECOUPLED_CHILD = textwrap.dedent(
     ])
     print(f"decoupled child {{pid}} OK", flush=True)
     """
-).format(repo=str(REPO))
+).format(repo=str(REPO)).replace("HASH_CAPTURE", HASH_CAPTURE)
 
 
 def test_two_process_ppo_decoupled(tmp_path):
@@ -208,6 +259,7 @@ def test_two_process_ppo_decoupled(tmp_path):
     assert ckpts, "no checkpoint written by the 2-process decoupled run"
     events = sorted((tmp_path / "logs").rglob("events.out.tfevents.*"))
     assert events, "rank 0 wrote no tensorboard events"
+    _assert_rank_params_identical(tmp_path)
 
 
 SAC_CHILD = textwrap.dedent(
@@ -222,6 +274,8 @@ SAC_CHILD = textwrap.dedent(
     coordinator, pid, tmp = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     sys.path.insert(0, {repo!r})
     from sheeprl_tpu.cli import run
+
+    HASH_CAPTURE
 
     run([
         "exp=sac",
@@ -249,7 +303,7 @@ SAC_CHILD = textwrap.dedent(
     ])
     print(f"sac child {{pid}} OK", flush=True)
     """
-).format(repo=str(REPO))
+).format(repo=str(REPO)).replace("HASH_CAPTURE", HASH_CAPTURE)
 
 
 def test_two_process_sac_training(tmp_path):
@@ -262,3 +316,4 @@ def test_two_process_sac_training(tmp_path):
     assert ckpts, "no checkpoint written by the 2-process SAC run"
     events = sorted((tmp_path / "logs").rglob("events.out.tfevents.*"))
     assert events, "rank 0 wrote no tensorboard events"
+    _assert_rank_params_identical(tmp_path)
