@@ -1,0 +1,75 @@
+"""Collect a learning-run's evidence into LEARNING_r{N}.json.
+
+Parses the TensorBoard events of a finished (or running) training run and emits the
+round's learning artifact: reward curve, final greedy test reward, steady train
+throughput, and the run's provenance.
+
+Usage::
+
+    python benchmarks/collect_learning.py <run_version_dir> <out.json> \
+        [--task "dm_control walker_walk, pixels only"] [--notes "..."]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("run_dir", help="the run's version_N directory (holds the tfevents file)")
+    ap.add_argument("out", help="output JSON path")
+    ap.add_argument("--task", default="")
+    ap.add_argument("--notes", default="")
+    args = ap.parse_args()
+
+    from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+
+    ea = EventAccumulator(args.run_dir, size_guidance={"scalars": 0})
+    ea.Reload()
+    tags = ea.Tags()["scalars"]
+
+    def series(tag):
+        return [(s.step, round(float(s.value), 2)) for s in ea.Scalars(tag)] if tag in tags else []
+
+    rewards = series("Rewards/rew_avg")
+    test_rewards = series("Test/cumulative_reward")
+    sps = [v for _, v in series("Time/sps_train")]
+    steady_sps = round(sum(sps[2:]) / max(len(sps[2:]), 1), 2) if len(sps) > 4 else (sps[-1] if sps else None)
+
+    cfg_path = os.path.join(os.path.dirname(args.run_dir.rstrip("/")), "..", "config.yaml")
+    for cand in (os.path.join(args.run_dir, "config.yaml"), cfg_path):
+        if os.path.isfile(cand):
+            cfg_path = cand
+            break
+    cfg = {}
+    try:
+        import yaml
+
+        with open(cfg_path) as f:
+            cfg = yaml.safe_load(f)
+    except Exception:
+        pass
+
+    out = {
+        "task": args.task or f"{cfg.get('env', {}).get('id', '?')} (pixels)",
+        "algo": f"{cfg.get('algo', {}).get('name', '?')}, buffer.device={cfg.get('buffer', {}).get('device')}, 1 TPU chip",
+        "policy_steps": int(cfg.get("algo", {}).get("total_steps", 0)),
+        "env_frames": int(cfg.get("algo", {}).get("total_steps", 0)) * int(cfg.get("env", {}).get("action_repeat", 1)),
+        "action_repeat": int(cfg.get("env", {}).get("action_repeat", 1)),
+        "train_reward_curve": rewards,
+        "final_test_reward": test_rewards[-1][1] if test_rewards else None,
+        "steady_sps_train_during_run": steady_sps,
+        "notes": args.notes,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "train_reward_curve"}, indent=1))
+    print(f"curve points: {len(rewards)} → {args.out}")
+
+
+if __name__ == "__main__":
+    main()

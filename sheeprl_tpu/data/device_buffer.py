@@ -323,6 +323,13 @@ def sample_index_block(rb, batch_size: int, sequence_length: int, n: int, dp: in
     if dp <= 1:
         idx = [rb.sample_idx(batch_size, sequence_length) for _ in range(n)]
         return np.stack([e for e, _ in idx]), np.stack([s for _, s in idx])
+    if batch_size % dp != 0 or rb.n_envs % dp != 0:
+        # device_replay_enabled guards the training loops; direct callers (dryrun,
+        # tests) must fail loudly rather than leave np.empty tails as garbage ids.
+        raise ValueError(
+            f"sharded index sampling needs batch_size ({batch_size}) and n_envs "
+            f"({rb.n_envs}) divisible by dp ({dp})"
+        )
     e_local = rb.n_envs // dp
     b_local = batch_size // dp
     envs = np.empty((n, batch_size), np.intp)

@@ -96,6 +96,7 @@ def test_sac_dummy_env(tmp_path):
     run(SAC_ARGS + standard_args(tmp_path, extra=["dry_run=False"]))
 
 
+@pytest.mark.slow
 def test_sac_resume_and_evaluate(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
@@ -338,6 +339,7 @@ def test_dreamer_v2_dummy_envs(tmp_path, env_id):
     run(DV2_ARGS + [f"env={env_id}"] + standard_args(tmp_path, extra=["dry_run=False"]))
 
 
+@pytest.mark.slow
 def test_dreamer_v2_episode_buffer(tmp_path):
     run(
         DV2_ARGS
@@ -560,6 +562,7 @@ def test_sac_decoupled_dummy_env(tmp_path):
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
 
 
+@pytest.mark.slow
 def test_dreamer_v3_decoupled_rssm(tmp_path):
     run(
         DV3_ARGS
@@ -662,6 +665,7 @@ def test_dreamer_v3_tensor_parallel_cli(tmp_path):
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
 
 
+@pytest.mark.slow
 def test_droq_evaluate_roundtrip(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
@@ -672,6 +676,7 @@ def test_droq_evaluate_roundtrip(tmp_path):
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
 
 
+@pytest.mark.slow
 def test_ppo_recurrent_evaluate_roundtrip(tmp_path):
     from sheeprl_tpu.cli import evaluate
 
@@ -695,6 +700,7 @@ def test_ppo_recurrent_evaluate_roundtrip(tmp_path):
     evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
 
 
+@pytest.mark.slow
 def test_sac_ae_evaluate_roundtrip(tmp_path):
     from sheeprl_tpu.cli import evaluate
 

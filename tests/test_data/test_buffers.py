@@ -128,6 +128,24 @@ def test_sequential_sample_too_long_raises():
         rb.sample(1, sequence_length=6)
 
 
+def test_sample_transition_idx_matches_sample_validity():
+    """Index-only transition sampling (the SAC-AE device mirror's sampler) draws
+    only filled rows / valid envs, both before and after the ring wraps."""
+    rb = ReplayBuffer(8, n_envs=3)
+    rb.seed(0)
+    rb.add(_data(5, 3))
+    idxs, envs = rb.sample_transition_idx(16, n_samples=2)
+    assert idxs.shape == envs.shape == (2, 16)
+    assert idxs.max() < 5 and idxs.min() >= 0  # only the 5 filled rows
+    assert envs.max() < 3 and envs.min() >= 0
+    rb.add(_data(6, 3, pos0=5))  # wraps: full buffer, every row valid
+    idxs, _ = rb.sample_transition_idx(64)
+    assert idxs.max() < 8
+    empty = ReplayBuffer(8, n_envs=1)
+    with pytest.raises(ValueError):
+        empty.sample_transition_idx(4)
+
+
 # -- EnvIndependentReplayBuffer ---------------------------------------------
 
 
