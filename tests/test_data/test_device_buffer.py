@@ -94,6 +94,14 @@ def test_sharded_mirror_parity_with_host():
         for j in range(batch):
             assert envs[g, j] // e_local == j // b_local
 
+    # Resume path under dp>1: a freshly-built sharded mirror rebuilt from the host
+    # buffer must hold the same rows (and keep the env sharding).
+    rebuilt = DeviceReplayMirror(cap, n_envs, _specs(), mesh=mesh, dp=dp)
+    rebuilt.load_from(rb)
+    for k in ("rgb", "rewards"):
+        np.testing.assert_array_equal(rebuilt.host_rows(k), mirror.host_rows(k), err_msg=f"load_from {k}")
+        assert rebuilt.arrays[k].sharding.spec == jax.sharding.PartitionSpec("data")
+
     # ...so the shard_map gather is shard-local and matches the host rows.
     gather = jax.jit(mirror.make_gather_fn(seq))
     out = gather(mirror.arrays, jnp.asarray(envs[0], jnp.int32), jnp.asarray(starts[0], jnp.int32))
