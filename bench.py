@@ -61,7 +61,7 @@ def _peak_flops(device) -> float:
     return 275e12  # assume v4 when unknown
 
 
-def bench_train_only(size: str = "S"):
+def bench_train_only(size: str = "S", batch: int = 16):
     import jax
     import jax.numpy as jnp
 
@@ -77,7 +77,7 @@ def bench_train_only(size: str = "S"):
         overrides=[
             "exp=dreamer_v3",
             f"algo=dreamer_v3_{size}",
-            "algo.per_rank_batch_size=16",
+            f"algo.per_rank_batch_size={batch}",
             "algo.per_rank_sequence_length=64",
         ]
     )

@@ -6,7 +6,8 @@ intensity.  This probe measures grad-steps/s + MFU for sizes S/M/L (same batch 1
 seq 64 × 64×64×3 config) on the real chip and prints one JSON line per size, feeding
 ``PROFILE_r04.md``.
 
-Usage: ``python benchmarks/mfu_sweep.py [S M L]``
+Usage: ``python benchmarks/mfu_sweep.py [S M L S:64]`` — ``SIZE:BATCH`` entries
+override the batch size (default 16), probing the arithmetic-intensity lever.
 """
 
 import json
@@ -18,10 +19,17 @@ from bench import bench_train_only  # noqa: E402
 
 
 def main() -> None:
-    sizes = sys.argv[1:] or ["S", "M", "L"]
-    for size in sizes:
-        gsps, mfu = bench_train_only(size)
-        print(json.dumps({"size": size, "grad_steps_per_sec": round(gsps, 4), "mfu": round(mfu, 4)}), flush=True)
+    entries = sys.argv[1:] or ["S", "M", "L"]
+    for entry in entries:
+        size, _, batch = entry.partition(":")
+        batch = int(batch) if batch else 16
+        gsps, mfu = bench_train_only(size, batch=batch)
+        print(
+            json.dumps(
+                {"size": size, "batch": batch, "grad_steps_per_sec": round(gsps, 4), "mfu": round(mfu, 4)}
+            ),
+            flush=True,
+        )
 
 
 if __name__ == "__main__":
