@@ -11,14 +11,18 @@ prefetch + training + logging) through the CLI on the deterministic dummy env, r
 the loop's own ``Time/sps_train`` / ``Time/sps_env_interaction`` plus overall
 policy-steps/s.  Set ``BENCH_E2E=0`` to skip.
 
-Baseline: the reference reports 14 h on 1× RTX 3080 for Atari-100K (README.md:46-53).
-100K frames at action-repeat 4 → 25K policy steps; replay ratio 0.5 → ~12.5K gradient
-steps ⇒ ≈0.25 grad-steps/s END-TO-END — the only comparison with a published basis, so
-``vs_baseline`` is measured_e2e / 0.248 (an e2e-vs-e2e ratio; it falls back to the
-train-only rate over the same denominator only if the e2e phase is skipped/failed,
-flagged by ``vs_baseline_kind``).  No train-only rate is published for the reference
-(BASELINE.md notes the cell is empty), so the train-only headline ``value`` carries no
-reference ratio of its own.
+Baseline (GPU-anchored, BASELINE.md "North-star anchor"): the reference reports 14 h on
+1× RTX 3080 for Atari MsPacman-100K (README.md:46-53).  Its exp config
+(``configs/exp/dreamer_v3_100k_ms_pacman.yaml``: ``total_steps=100000``,
+``learning_starts=1024``, DV3 default ``replay_ratio: 1``) + the Ratio call at
+``dreamer_v3.py:661-662`` (grad steps = ratio × (policy_step − prefill), where
+policy_step already counts action-repeated frames) give ≈ 1.0 × (100000 − 1024) ≈
+98,976 gradient steps in 14 h ⇒ **1.963 grad-steps/s end-to-end** on the 1-GPU
+baseline, at the same batch 16 × seq 64 × size S this bench runs.  ``vs_baseline`` is
+measured_e2e / 1.963 (e2e-vs-e2e at matched batch/seq/model; the e2e phase here also
+runs replay_ratio=1); it falls back to the train-only rate over the same denominator
+only if the e2e phase is skipped/failed, flagged by ``vs_baseline_kind``.  The north
+star (BASELINE.json) asks ≥2× this rate; ``north_star_met`` states the verdict.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
@@ -36,9 +40,9 @@ import numpy as np
 
 os.environ.setdefault("SHEEPRL_TPU_QUIET", "1")
 
-# Reference end-to-end rate implied by its published Atari-100K wall-clock (see above):
-# ~12.5K gradient steps / 14 h ≈ 0.248 grad-steps/s on 1× RTX 3080.
-BASELINE_E2E_GRAD_STEPS_PER_SEC = 0.248
+# Reference 1-GPU end-to-end rate derived from its published Atari MsPacman-100K
+# wall-clock (docstring above): ~98,976 gradient steps / 14 h on 1× RTX 3080.
+BASELINE_E2E_GRAD_STEPS_PER_SEC = 1.963
 
 # Peak dense bf16 FLOP/s per chip (public figures).
 PEAK_FLOPS = {
@@ -230,10 +234,15 @@ def main() -> None:
     # e2e-to-e2e; the train-only rate has no published counterpart.
     if "e2e_sps_train" in extras:
         vs_baseline = extras["e2e_sps_train"] / BASELINE_E2E_GRAD_STEPS_PER_SEC
-        vs_kind = "e2e_sps_train / reference_implied_e2e(0.248)"
+        vs_kind = (
+            "e2e_sps_train / reference_GPU_e2e(1.963 = 98976 grad steps / 14h, "
+            "MsPacman-100K on 1x RTX 3080, batch 16 x seq 64, size S, replay_ratio 1)"
+        )
     else:
         vs_baseline = gsps / BASELINE_E2E_GRAD_STEPS_PER_SEC
-        vs_kind = "train_only / reference_implied_e2e(0.248) — e2e phase unavailable"
+        vs_kind = (
+            "train_only / reference_GPU_e2e(1.963) — e2e phase unavailable"
+        )
     print(
         json.dumps(
             {
@@ -242,6 +251,9 @@ def main() -> None:
                 "unit": "grad_steps/s (batch 16 x seq 64, 64x64x3 obs, 1 chip)",
                 "vs_baseline": round(vs_baseline, 4),
                 "vs_baseline_kind": vs_kind,
+                # only an e2e measurement can answer the (e2e-defined) north star
+                "north_star_met": bool(vs_baseline >= 2.0) if "e2e_sps_train" in extras else None,
+                "north_star": "BASELINE.json: >=2x the reference 1-GPU grad-steps/s at matched batch/seq",
                 "mfu": round(mfu, 4),
                 **extras,
             }

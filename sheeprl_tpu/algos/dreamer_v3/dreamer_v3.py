@@ -363,8 +363,9 @@ def main(ctx, cfg) -> None:
     # (env, start) indices, and each scan step gathers its batch in-jit — removes
     # the host→device batch traffic that otherwise floors e2e throughput.  Under
     # data parallelism the ring's env axis is sharded over the `data` mesh axis
-    # (per-shard sampling + shard_map gather); only multi-process runs fall back
-    # to host sampling + async prefetch.
+    # (per-shard sampling + shard_map gather); multi-process runs keep the fast
+    # path too via per-process local rings + a zero-copy global view
+    # (data/device_buffer.py: MultiProcessDeviceReplayMirror).
 
     player_step = make_player_step(world_model, actor, actions_dim, cfg.algo.world_model.discrete_size)
     player_jit = jax.jit(player_step, static_argnames=("greedy",))
@@ -476,7 +477,7 @@ def main(ctx, cfg) -> None:
         for iter_num in range(start_iter, num_iters + 1):
             env_time = 0.0
             env_t0 = time.perf_counter()
-            with timer("Time/env_interaction_time"):
+            with timer("Time/env_interaction_time"), timer("Time/phase_player"):
                 if iter_num <= learning_starts and not cfg.checkpoint.get("resume_from"):
                     if is_continuous:
                         stored_actions = np.stack([act_space.sample() for _ in range(num_envs)]).astype(np.float32)
@@ -514,7 +515,8 @@ def main(ctx, cfg) -> None:
                 # (under the prefetcher's lock: the sampler thread must not read rows
                 # mid-write).
                 step_data["actions"] = stored_actions.reshape(1, num_envs, -1)
-                rb_add(step_data, validate_args=cfg.buffer.validate_args)
+                with timer("Time/phase_buffer_add"):
+                    rb_add(step_data, validate_args=cfg.buffer.validate_args)
             env_time += time.perf_counter() - env_t0
 
             # ---- dispatch this iteration's gradient block BEFORE stepping the envs:
@@ -528,16 +530,17 @@ def main(ctx, cfg) -> None:
                     (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
                 )
                 if grad_steps > 0:
-                    params, opt_states, moments_state = _run_block(
-                        (params, opt_states, moments_state),
-                        grad_steps,
-                        cumulative_grad_steps,
-                        stage_next=iter_num < num_iters,
-                    )
+                    with timer("Time/phase_dispatch"):
+                        params, opt_states, moments_state = _run_block(
+                            (params, opt_states, moments_state),
+                            grad_steps,
+                            cumulative_grad_steps,
+                            stage_next=iter_num < num_iters,
+                        )
                     cumulative_grad_steps += grad_steps
 
             env_t0 = time.perf_counter()
-            with timer("Time/env_interaction_time"):
+            with timer("Time/env_interaction_time"), timer("Time/phase_env_step"):
                 next_obs, reward, terminated, truncated, info = envs.step(env_actions)
                 if cfg.env.clip_rewards:
                     reward = np.clip(reward, -1, 1)
@@ -582,27 +585,9 @@ def main(ctx, cfg) -> None:
                 record_episode_stats(aggregator, info)
             env_time += time.perf_counter() - env_t0
 
-            if logger is not None and (
-                policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
-            ):
-                # The drain below is the window's only blocking sync: it waits for
-                # every gradient block dispatched in the window, so the window
-                # wall-clock is an honest end-to-end grad-steps/s denominator.
-                dispatcher.drain(aggregator)
-                metrics = aggregator.compute()
-                window_sps = dispatcher.pop_window_sps()
-                if window_sps is not None:
-                    metrics["Time/sps_train"] = window_sps
-                metrics["Time/sps_env_interaction"] = (
-                    policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
-                )
-                metrics["Params/replay_ratio"] = (
-                    cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
-                )
-                logger.log_metrics(metrics, policy_step)
-                aggregator.reset()
-                last_log = policy_step
-
+            # Checkpoint BEFORE the log flush so phase_checkpoint lands in the
+            # window it was paid in (and the final save_last is not dropped from
+            # the breakdown).
             if (
                 cfg.checkpoint.every > 0
                 and (policy_step - last_checkpoint) >= cfg.checkpoint.every
@@ -620,10 +605,36 @@ def main(ctx, cfg) -> None:
                     "last_checkpoint": policy_step,
                     "cumulative_grad_steps": cumulative_grad_steps,
                 }
-                if cfg.buffer.checkpoint:
-                    state["rb"] = rb.state_dict()
-                ckpt_manager.save(policy_step, state)
+                with timer("Time/phase_checkpoint"):
+                    if cfg.buffer.checkpoint:
+                        state["rb"] = rb.state_dict()
+                    ckpt_manager.save(policy_step, state)
                 last_checkpoint = policy_step
+
+            if logger is not None and (
+                policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
+            ):
+                # The drain below is the window's only blocking sync: it waits for
+                # every gradient block dispatched in the window, so the window
+                # wall-clock is an honest end-to-end grad-steps/s denominator.
+                with timer("Time/phase_drain"):
+                    dispatcher.drain(aggregator)
+                metrics = aggregator.compute()
+                # Per-phase wall-clock breakdown over the window (seconds); the
+                # nested player timer includes buffer_add — subtract when reading.
+                metrics.update(timer.to_dict(reset=True))
+                window_sps = dispatcher.pop_window_sps()
+                if window_sps is not None:
+                    metrics["Time/sps_train"] = window_sps
+                metrics["Time/sps_env_interaction"] = (
+                    policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
+                )
+                metrics["Params/replay_ratio"] = (
+                    cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
+                )
+                logger.log_metrics(metrics, policy_step)
+                aggregator.reset()
+                last_log = policy_step
 
     finally:
         envs.close()

@@ -26,11 +26,19 @@ likewise shard-local (full-env masked updates).  This is what lets the flagship 
 path compose with DP on a multi-chip host (the v4-8 north star) instead of falling
 back to host sampling.
 
+**Multi-process** (v4-32-class): each process keeps a LOCAL ring over its own
+devices' slice of the ``data`` axis (scatter stays process-local and collective-free
+— episode ends, and therefore terminal-row scatters, happen at process-divergent
+iterations), and the SPMD train block sees a zero-copy GLOBAL view assembled with
+``jax.make_array_from_single_device_arrays``.  Index arrays are likewise per-process
+sampled and globalized with ``jax.make_array_from_process_local_data`` — value
+divergence lives in array *shards*, which is exactly what GSPMD permits, never in
+replicated scalars.  See :class:`MultiProcessDeviceReplayMirror`.
+
 The mirror requires the whole buffer to fit in HBM next to the model: ~1.2 GB for
 the 100K-transition Atari-100K config — comfortable on any current TPU.  Enabled by
 ``buffer.device: True`` (the flagship default); loops fall back to host sampling +
-prefetch when disabled (or multi-process — per-process mirrors would feed the SPMD
-program process-divergent index arrays, which JAX does not value-check).
+prefetch when disabled.
 """
 
 from __future__ import annotations
@@ -207,19 +215,28 @@ class DeviceReplayMirror:
         block.  Output ``[T, B, ...]`` is sharded over ``data`` on the batch axis,
         identical to the host path's ``put_batch(..., batch_axis=1)``."""
         shapes = self._row_shapes
-        if self.dp <= 1:
+        gather_mesh = self._gather_mesh()
+        if gather_mesh is None:
             return lambda m, e, s: gather_sequences(m, e, s, sequence_length, row_shapes=shapes)
-        e_local = self.n_envs // self.dp
+        # envs per shard — same count locally and globally (contiguous env blocks),
+        # so global env ids reduce to shard-local rows by the same modulus.
+        e_local = self.n_envs // max(self.dp, 1)
 
         def local_gather(mirror, envs, starts):
             return gather_sequences(mirror, envs % e_local, starts, sequence_length, row_shapes=shapes)
 
         return jax.shard_map(
             local_gather,
-            mesh=self.mesh,
+            mesh=gather_mesh,
             in_specs=(P("data"), P("data"), P("data")),
             out_specs=P(None, "data"),
         )
+
+    def _gather_mesh(self):
+        """Mesh the batch gather shard_maps over (None = unsharded single-device
+        gather).  The multi-process subclass returns the GLOBAL mesh here while
+        scatters stay on the local one."""
+        return self.mesh if self.dp > 1 else None
 
     def make_transition_gather_fn(self):
         """In-jit ``[n, B]`` transition-row gather (SAC-AE's batch shape): returns
@@ -243,19 +260,121 @@ class DeviceReplayMirror:
         return np.moveaxis(arr, 0, 1).reshape(self.capacity, self.n_envs, *self._row_shapes[key])
 
 
+def _data_axis_devices(mesh) -> list:
+    """Devices along the mesh's ``data`` axis, in axis order (requires the pure-DP
+    topology the multi-process mirror supports: ``model == sequence == 1``)."""
+    return list(mesh.devices.reshape(-1))
+
+
+def _local_data_block(mesh):
+    """This process's contiguous block of the global ``data`` axis, or ``None`` if
+    its devices are not contiguous/aligned (the mirror then cannot map its env block
+    onto the axis).  Returns ``(local_devices_in_axis_order, block_start)``."""
+    devs = _data_axis_devices(mesh)
+    me = jax.process_index()
+    idxs = [i for i, d in enumerate(devs) if d.process_index == me]
+    if not idxs or idxs != list(range(idxs[0], idxs[0] + len(idxs))):
+        return None
+    return [devs[i] for i in idxs], idxs[0]
+
+
+class MultiProcessDeviceReplayMirror(DeviceReplayMirror):
+    """Per-process LOCAL ring + zero-copy GLOBAL view for multi-process (multi-host)
+    data parallelism.
+
+    Design constraints this satisfies (why the r4 gate existed):
+
+    * **Scatters must not be collective.**  Terminal-row adds fire when an episode
+      ends — at different iterations on different processes.  A global SPMD scatter
+      would deadlock; here every scatter runs on the process's OWN devices only
+      (the base class, over a local ``data`` submesh), so processes scatter freely.
+    * **The train block must stay SPMD.**  All processes dispatch the same jitted
+      block in lockstep (gradient counts derive from the global policy-step count).
+      Its replay inputs carry the per-process divergence as array SHARDS: the ring
+      is re-exposed per dispatch as a global ``[world×n_envs, cap, flat]`` array via
+      ``jax.make_array_from_single_device_arrays`` (metadata only — no copy, the
+      shards ARE the local ring's buffers), and the per-process sampled index
+      arrays become batch-sharded global arrays via
+      ``jax.make_array_from_process_local_data``.
+    * **Gathers never cross processes.**  Batch element ``j`` samples only from the
+      env block its shard owns (``sample_index_block`` per-shard sampling +
+      rank-offset ids), so the global-mesh ``shard_map`` gather is shard-local —
+      identical math to the single-process DP path, just over the global mesh.
+
+    In-place safety: a dispatch's global view references the same HBM buffers the
+    next iteration's (donating) scatter overwrites — safe for the same reason the
+    single-process path is: per-device program queues execute in dispatch order.
+    """
+
+    def __init__(self, capacity: int, n_envs_local: int, specs, global_mesh):
+        block = _local_data_block(global_mesh)
+        if block is None:
+            raise ValueError("process's devices are not a contiguous block of the data axis")
+        local_devs, block_start = block
+        k = len(local_devs)
+        self._global_mesh = global_mesh
+        self._world = jax.process_count()
+        self._block_start = block_start
+        local_mesh = (
+            jax.sharding.Mesh(np.asarray(local_devs).reshape(k), axis_names=("data",)) if k > 1 else None
+        )
+        super().__init__(capacity, n_envs_local, specs, mesh=local_mesh, dp=k)
+        self.local_dp = k
+        # Global env ids must follow the DATA-AXIS position of this process's
+        # device block, not its process index: global_view() places rows by
+        # device, so if the axis were not process-ordered, a process_index-based
+        # offset would silently gather other processes' rows.
+        self.env_offset = block_start * (n_envs_local // k)
+        self._view_shardings = {
+            key: NamedSharding(global_mesh, P("data", None, None)) for key in specs
+        }
+        self._index_sharding = NamedSharding(global_mesh, P(None, "data"))
+
+    @property
+    def global_envs(self) -> int:
+        return self.n_envs * self._world
+
+    def _gather_mesh(self):
+        return self._global_mesh
+
+    def global_view(self) -> Dict[str, jax.Array]:
+        """The SPMD train block's ring input: global env-sharded arrays whose shards
+        are the CURRENT local ring buffers (metadata-only assembly, per dispatch)."""
+        out = {}
+        for k, arr in self.arrays.items():
+            shards = [s.data for s in arr.addressable_shards]
+            out[k] = jax.make_array_from_single_device_arrays(
+                (self.global_envs, self.capacity, self._flat[k]), self._view_shardings[k], shards
+            )
+        return out
+
+    def globalize_indices(self, envs: np.ndarray, starts: np.ndarray):
+        """Per-process ``[G, B_local]`` int32 index blocks (LOCAL env ids) → global
+        ``[G, world×B_local]`` batch-sharded arrays with global env ids."""
+        genvs = np.ascontiguousarray(envs + self.env_offset, np.int32)
+        gstarts = np.ascontiguousarray(starts, np.int32)
+        g, b_local = genvs.shape
+        shape = (g, b_local * self._world)
+        return (
+            jax.make_array_from_process_local_data(self._index_sharding, genvs, shape),
+            jax.make_array_from_process_local_data(self._index_sharding, gstarts, shape),
+        )
+
+
 def device_replay_enabled(ctx, cfg, require_sequential: bool = False, allow_dp: bool = True) -> bool:
     """The ``buffer.device`` gate shared by every device-replay consumer.  Every
     fallback logs why, so a requested device buffer never degrades silently.
     Requirements:
 
-    * single process — per-process mirrors would sample process-divergent index
-      arrays and feed them to the SPMD train block, which JAX does not
-      value-check (silent replica divergence);
     * for DV2, sequential buffers only (the episode buffer stays on host);
     * under data parallelism, ``num_envs`` and the batch size must divide the
-      ``data`` axis so the env-sharded ring and the per-shard sampler line up —
-      or, for loops whose mirror is not sharded (``allow_dp=False``, SAC-AE's
-      transition mirror), any ``data > 1`` falls back.
+      (per-process) ``data`` axis so the env-sharded ring and the per-shard
+      sampler line up — or, for loops whose mirror is not sharded
+      (``allow_dp=False``, SAC-AE's transition mirror), any ``data > 1`` or
+      multi-process topology falls back;
+    * multi-process additionally needs a pure-DP mesh (``model == sequence == 1``)
+      with each process's devices a contiguous block of the ``data`` axis — the
+      :class:`MultiProcessDeviceReplayMirror` topology.
     """
     import logging
 
@@ -268,20 +387,42 @@ def device_replay_enabled(ctx, cfg, require_sequential: bool = False, allow_dp: 
             "buffer stays on host); falling back to host sampling."
         )
         return False
-    if jax.process_count() > 1:
-        log.warning(
-            "buffer.device=True is single-process only (per-process mirrors would "
-            "feed the SPMD program divergent index arrays); falling back to "
-            "host-side sampling with the async prefetcher."
-        )
-        return False
-    if not allow_dp and ctx.data_parallel_size > 1:
+    world = jax.process_count()
+    if not allow_dp and (ctx.data_parallel_size > 1 or world > 1):
         log.warning(
             "buffer.device=True is single-chip for this algorithm (its mirror is "
             "not sharded); falling back to host-side sampling with the async "
             "prefetcher."
         )
         return False
+    if world > 1:
+        if ctx.mesh.shape["model"] > 1 or ctx.mesh.shape["sequence"] > 1:
+            log.warning(
+                "buffer.device=True over multiple processes supports pure data "
+                "parallelism only (mesh.model = mesh.sequence = 1); falling back "
+                "to host-side sampling."
+            )
+            return False
+        block = _local_data_block(ctx.mesh)
+        if block is None:
+            log.warning(
+                "buffer.device=True needs each process's devices to form a "
+                "contiguous block of the data axis; falling back to host-side "
+                "sampling."
+            )
+            return False
+        k = len(block[0])
+        if cfg.env.num_envs % k != 0 or cfg.algo.per_rank_batch_size % k != 0:
+            log.warning(
+                "buffer.device=True with %d local devices on the data axis needs "
+                "env.num_envs (%d) and algo.per_rank_batch_size (%d) divisible by "
+                "it; falling back to host-side sampling.",
+                k,
+                cfg.env.num_envs,
+                cfg.algo.per_rank_batch_size,
+            )
+            return False
+        return True
     dp = ctx.data_parallel_size
     if dp > 1 and (cfg.env.num_envs % dp != 0 or cfg.algo.per_rank_batch_size % dp != 0):
         log.warning(
@@ -395,13 +536,20 @@ def make_device_replay(
             [("actions", act_dim_sum), ("rewards", 1), ("terminated", 1), ("truncated", 1), ("is_first", 1)],
             ctx=ctx,
         )
-        dispatcher = IndexedBlockDispatcher(step_fn, gather_fn=mirror.make_gather_fn(seq_len), **kwargs)
+        multiprocess = isinstance(mirror, MultiProcessDeviceReplayMirror)
+        dispatcher = IndexedBlockDispatcher(
+            step_fn,
+            gather_fn=mirror.make_gather_fn(seq_len),
+            globalize=mirror.globalize_indices if multiprocess else None,
+            **kwargs,
+        )
         prefetcher, rb_lock = None, contextlib.nullcontext()
-        dp = mirror.dp
+        dp = mirror.local_dp if multiprocess else mirror.dp
 
         def run_block(carry, n: int, start_count: int, stage_next: bool = True):
             envs_idx, starts_idx = sample_index_block(rb, batch_size, seq_len, n, dp=dp)
-            return dispatcher.dispatch(carry, mirror.arrays, envs_idx, starts_idx, start_count)
+            arrays = mirror.global_view() if multiprocess else mirror.arrays
+            return dispatcher.dispatch(carry, arrays, envs_idx, starts_idx, start_count)
 
     else:
         mirror = None
@@ -431,6 +579,8 @@ def make_mirror_for(rb, cnn_keys, mlp_keys, obs_space, extra_float_keys, ctx=Non
         specs[k] = ((int(np.prod(obs_space[k].shape)),), jnp.float32)
     for k, dim in extra_float_keys:
         specs[k] = ((int(dim),), jnp.float32)
+    if ctx is not None and jax.process_count() > 1:
+        return MultiProcessDeviceReplayMirror(rb.buffer_size, rb.n_envs, specs, global_mesh=ctx.mesh)
     mesh = ctx.mesh if ctx is not None and ctx.data_parallel_size > 1 else None
     dp = ctx.data_parallel_size if ctx is not None else 1
     return DeviceReplayMirror(rb.buffer_size, rb.n_envs, specs, mesh=mesh, dp=dp)

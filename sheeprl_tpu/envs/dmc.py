@@ -90,6 +90,23 @@ class DMCWrapper(gym.Env):
         truncated = timestep.last() and not terminated
         return self._obs(timestep), reward, terminated, truncated, {}
 
+    def step_repeat(self, action, amount: int):
+        """``amount`` physics steps, ONE observation: the :class:`~sheeprl_tpu.envs.
+        wrappers.ActionRepeat` fast path.  Intermediate observations are discarded by
+        the repeat loop anyway, and with software GL the 64×64 render dominates the
+        step cost — rendering only the surviving frame halves the env wall-clock."""
+        action = np.asarray(action, dtype=self.action_space.dtype)
+        total = 0.0
+        timestep = None
+        for _ in range(max(int(amount), 1)):
+            timestep = self._env.step(action)
+            total += timestep.reward or 0.0
+            if timestep.last():
+                break
+        terminated = timestep.last() and timestep.discount == 0.0
+        truncated = timestep.last() and not terminated
+        return self._obs(timestep), total, terminated, truncated, {}
+
     def reset(self, seed=None, options=None):
         timestep = self._env.reset()
         return self._obs(timestep), {}

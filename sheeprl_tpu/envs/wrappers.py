@@ -29,12 +29,21 @@ class ActionRepeat(gym.Wrapper):
         if amount <= 0:
             raise ValueError("`amount` should be a positive integer")
         self._amount = amount
+        # Adapter fast path: an env exposing ``step_repeat(action, amount)`` runs the
+        # repeat loop itself and materialises only the LAST observation (the generic
+        # loop discards the intermediates, but the adapter has already paid to render
+        # them — for pixel envs that is half the env wall-clock).  Bound only when
+        # ActionRepeat wraps the adapter DIRECTLY — reaching through intermediate
+        # wrappers would silently skip their step() logic.
+        self._native = getattr(env, "step_repeat", None) if env.unwrapped is env else None
 
     @property
     def action_repeat(self) -> int:
         return self._amount
 
     def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        if self._native is not None:
+            return self._native(action, self._amount)
         done = truncated = False
         total_reward = 0.0
         obs, info = None, {}

@@ -227,6 +227,7 @@ class IndexedBlockDispatcher:
         max_chunk: int = 8,
         count_offset: int = 1,
         base_key=None,
+        globalize: Callable = None,
     ):
         freq = max(int(target_update_freq), 1)
 
@@ -248,10 +249,15 @@ class IndexedBlockDispatcher:
         self._max_chunk = max_chunk
         self._futures = WindowedFutures()
         self._base_key = base_key
+        # Multi-process hook (MultiProcessDeviceReplayMirror.globalize_indices):
+        # turns each chunk's per-process [size, B_local] numpy index block into
+        # batch-sharded global arrays.  None = single-process, numpy goes in as-is.
+        self._globalize = globalize
 
     def dispatch(self, carry, mirror: dict, envs, starts, start_count: int):
-        """``envs``/``starts``: ``[G, B]`` numpy int arrays.  Returns the new carry
-        (device futures — nothing blocks here)."""
+        """``envs``/``starts``: ``[G, B]`` numpy int arrays (per-process local under
+        multi-process).  Returns the new carry (device futures — nothing blocks
+        here)."""
         import numpy as np
 
         G = envs.shape[0]
@@ -260,6 +266,8 @@ class IndexedBlockDispatcher:
             e = np.ascontiguousarray(envs[offset : offset + size], dtype=np.int32)
             s = np.ascontiguousarray(starts[offset : offset + size], dtype=np.int32)
             offset += size
+            if self._globalize is not None:
+                e, s = self._globalize(e, s)
             carry, metrics = self._block(carry, mirror, e, s, self._base_key, start_count)
             start_count += size
             self._futures.track(metrics, size)

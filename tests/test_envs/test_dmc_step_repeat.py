@@ -1,0 +1,52 @@
+"""DMC ``step_repeat`` fast path (the ActionRepeat adapter protocol): one render per
+repeated step instead of one per physics step, with EXACTLY the generic loop's
+trajectory — same physics, same summed rewards, same surviving observation."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE
+
+pytestmark = pytest.mark.skipif(not _IS_DMC_AVAILABLE, reason="dm_control not installed")
+
+
+def _rollout(use_native: bool, steps: int = 10):
+    from sheeprl_tpu.envs.dmc import DMCWrapper
+    from sheeprl_tpu.envs.wrappers import ActionRepeat
+
+    env = DMCWrapper("cartpole_balance", seed=3, from_pixels=False, from_vectors=True)
+    ar = ActionRepeat(env, 2)
+    if not use_native:
+        ar._native = None  # force the generic repeat loop
+    obs, _ = ar.reset()
+    rng = np.random.default_rng(0)
+    rewards, states = [], []
+    for _ in range(steps):
+        action = rng.uniform(-1, 1, env.action_space.shape).astype(np.float32)
+        obs, reward, terminated, truncated, _ = ar.step(action)
+        rewards.append(reward)
+        states.append(obs["state"].copy())
+    return np.asarray(rewards), np.stack(states)
+
+
+def test_step_repeat_matches_generic_loop():
+    r_generic, s_generic = _rollout(use_native=False)
+    r_native, s_native = _rollout(use_native=True)
+    np.testing.assert_allclose(r_native, r_generic, rtol=0, atol=0)
+    np.testing.assert_array_equal(s_native, s_generic)
+
+
+def test_action_repeat_binds_fast_path():
+    from sheeprl_tpu.envs.dmc import DMCWrapper
+    from sheeprl_tpu.envs.wrappers import ActionRepeat
+
+    import gymnasium as gym
+
+    env = DMCWrapper("cartpole_balance", seed=0, from_pixels=False, from_vectors=True)
+    assert ActionRepeat(env, 2)._native is not None
+
+    # no step_repeat -> generic loop
+    assert ActionRepeat(gym.make("CartPole-v1"), 2)._native is None
+
+    # an intermediate wrapper means the fast path would skip its step(): unbound
+    assert ActionRepeat(gym.wrappers.TransformReward(env, lambda r: r), 2)._native is None
