@@ -261,17 +261,59 @@ class ReplayBuffer:
 
     # -- checkpoint state ---------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
-        return {
-            "buffer": {k: _np(v).copy() for k, v in self._buf.items()},
-            "pos": self._pos,
-            "full": self._full,
-        }
+        """Memmap-backed storage checkpoints as a flushed metadata REFERENCE (the
+        :class:`~sheeprl_tpu.utils.memmap.MemmapArray` pickling protocol — same
+        semantics as the reference ``sheeprl/utils/memmap.py:240-258``): the rows
+        already live on disk, so copying them into the pickle would cost minutes
+        of wall-clock and a full extra buffer of disk PER CHECKPOINT.  The
+        checkpoint therefore points at the run's live memmap files — exact when
+        resuming the latest checkpoint; an older one sees the ring's newer rows
+        (bounded skew, identical to the reference's behavior).  RAM-backed
+        storage still snapshots by value.
+
+        Disk lifecycle: once a run checkpoints its buffer, its ``memmap_buffer``
+        directory outlives the process (that is what makes the references
+        resumable) and is reclaimed by deleting the run directory — at most one
+        buffer-sized footprint per checkpointed run, the same profile as the
+        reference's memmap runs."""
+        buf = {}
+        for k, v in self._buf.items():
+            if isinstance(v, MemmapArray):
+                v.flush()
+                # The checkpoint now REFERENCES the backing file, so the buffer
+                # must stop deleting it at GC/exit (``__del__`` still flushes) —
+                # checkpointed memmap storage outlives the run by design.
+                v.has_ownership = False
+                buf[k] = v
+            else:
+                buf[k] = _np(v).copy()
+        return {"buffer": buf, "pos": self._pos, "full": self._full}
 
     def load_state_dict(self, state: Dict[str, Any]) -> "ReplayBuffer":
+        """Restore a checkpointed buffer.  Memmap references are COPIED into this
+        buffer's own (fresh) storage rather than reattached in place: reattaching
+        would make the resumed run write into files that older checkpoints still
+        reference, silently corrupting them.  The one-time copy is the price of
+        keeping every checkpoint's view immutable.  Source files are opened
+        read-only, so resuming from a read-only archive works; a missing source
+        (the original run's ``memmap_buffer`` dir was deleted or the checkpoint
+        was moved without it) fails with a clear error."""
         for k, v in state["buffer"].items():
+            if isinstance(v, MemmapArray):
+                try:
+                    src = np.memmap(v.filename, dtype=v.dtype, mode="r", shape=v.shape)
+                except (FileNotFoundError, OSError) as exc:
+                    raise RuntimeError(
+                        f"buffer checkpoint for key '{k}' references memmap storage at "
+                        f"{v.filename!r}, which is not readable. Memmap buffers are "
+                        "checkpointed by reference — resuming needs the original run's "
+                        "memmap_buffer directory alongside the checkpoint."
+                    ) from exc
+            else:
+                src = v
             if k not in self._buf:
-                self._init_storage(k, v.shape[2:], v.dtype)
-            self._buf[k][:] = v
+                self._init_storage(k, src.shape[2:], src.dtype)
+            self._buf[k][:] = _np(src)
         self._pos = state["pos"]
         self._full = state["full"]
         return self

@@ -112,6 +112,11 @@ class MemmapArray:
     def __len__(self) -> int:
         return self._shape[0] if self._shape else 0
 
+    def flush(self) -> None:
+        """Force buffered writes to the backing file (checkpoint durability)."""
+        if self._array is not None:
+            self._array.flush()
+
     def __repr__(self) -> str:
         return f"MemmapArray(shape={self._shape}, dtype={self._dtype}, file={self._filename})"
 

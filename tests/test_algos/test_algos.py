@@ -633,9 +633,11 @@ def test_dreamer_v3_memmap_buffer_resume(tmp_path):
     run(args + standard_args(tmp_path, extra=extra))
     ckpts = _ckpts(tmp_path)
     assert ckpts
-    # MemmapArray.__del__ unlinks the files when the buffer is collected at the end
-    # of run(), so only the storage directory survives to assertion time.
-    assert list(tmp_path.rglob("memmap_buffer")), "no memmap storage created despite buffer.memmap=True"
+    # The buffer checkpoint stores memmap METADATA (not a copy), releasing file
+    # ownership — the backing .memmap files must therefore survive run() for the
+    # resume below to reattach to them.
+    files = list(tmp_path.rglob("memmap_buffer/**/*.memmap"))
+    assert files, "no memmap storage survived despite buffer.memmap=True + checkpoint"
     run(
         args
         + [f"checkpoint.resume_from={ckpts[-1]}", "algo.total_steps=48"]
