@@ -95,11 +95,28 @@ def main() -> None:
     wm = params["world_model"]
     key = jax.random.PRNGKey(cfg.seed)
 
+    def _to_rgb3(frame: np.ndarray) -> np.ndarray:
+        """[C, H, W] uint8 -> 3-channel: tile grayscale, keep the first 3 of stacks."""
+        if frame.shape[0] < 3:
+            frame = np.repeat(frame[-1:], 3, axis=0)
+        return frame[:3]
+
+    # Scaling for float observations, decided ONCE from the env's declared range
+    # (a per-frame min() heuristic would flicker between branches on bright frames).
+    _space = env.observation_space[cnn_keys[0]]
+    _lo, _hi = float(np.min(_space.low)), float(np.max(_space.high))
+    _span = (_hi - _lo) if np.isfinite(_hi - _lo) and _hi > _lo else 1.0
+
+    def _to_uint8(raw: np.ndarray) -> np.ndarray:
+        if np.issubdtype(raw.dtype, np.floating):
+            raw = np.clip((raw - _lo) * (255.0 / _span), 0, 255)
+        return raw.astype(np.uint8)
+
     def decode_frame(stoch, recurrent):
         latent = jnp.concatenate([stoch, recurrent], -1)
         recon = world_model.apply(wm, latent, method=WorldModel.decode)
         img = np.asarray(recon[cnn_keys[0]][0], np.float32)  # [C, H, W], ~[-0.5, 0.5]
-        return np.clip((img + 0.5) * 255.0, 0, 255).astype(np.uint8)
+        return _to_rgb3(np.clip((img + 0.5) * 255.0, 0, 255).astype(np.uint8))
 
     # --- context: real steps through the trained player (posterior latents)
     obs, _ = env.reset(seed=cfg.seed)
@@ -111,7 +128,7 @@ def main() -> None:
         actions, stored, state = player_step(params, state, obs_tree(obs), is_first, sub, greedy=True)
         is_first = jnp.zeros((1, 1))
         raw = np.asarray(obs[cnn_keys[0]]).reshape(-1, *np.asarray(obs[cnn_keys[0]]).shape[-2:])
-        real_frames.append(raw[:3].astype(np.uint8))
+        real_frames.append(_to_rgb3(_to_uint8(raw)))
         recon_frames.append(decode_frame(state.stochastic_state, state.recurrent_state))
         if t == opts["context"] - 1:
             break_state = state  # imagination starts from the last posterior

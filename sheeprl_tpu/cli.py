@@ -156,19 +156,56 @@ def _default_run_name(cfg: Dict[str, Any]) -> str:
     return f"{stamp}_{cfg.get('exp_name', 'run')}_{cfg.get('seed', 0)}"
 
 
+def expand_multirun(overrides: List[str]) -> List[List[str]]:
+    """Hydra-multirun semantics (reference ``cli.py:358`` ``@hydra.main`` with ``-m``):
+    every override whose value is a bare comma-separated list becomes a sweep axis,
+    and the grid is their cartesian product, e.g. ``algo.lr=1e-4,3e-4 seed=1,2`` →
+    4 jobs.  Bracketed/quoted values (``cnn_keys.encoder=[rgb,depth]``) are single
+    values, never axes."""
+    import itertools
+
+    axes: List[List[str]] = []
+    for ov in overrides:
+        key, eq, val = ov.partition("=")
+        if eq and "," in val and not val.lstrip().startswith(("[", "{", "(", "'", '"')):
+            axes.append([f"{key}={v}" for v in val.split(",")])
+        else:
+            axes.append([ov])
+    return [list(combo) for combo in itertools.product(*axes)]
+
+
 def run(args: Optional[List[str]] = None) -> None:
-    """Train entry: ``python -m sheeprl_tpu exp=... key=value ...``"""
+    """Train entry: ``python -m sheeprl_tpu exp=... key=value ...``
+
+    ``-m`` / ``--multirun`` sweeps comma-separated override values as a grid
+    (sequential execution), mirroring the reference's Hydra multirun: each job's
+    ``run_name`` gains a ``multirun_<stamp>/job<i>`` prefix so the sweep lands in
+    one directory tree."""
     _import_algorithms()
     overrides = list(args if args is not None else sys.argv[1:])
-    cfg = compose(overrides=overrides)
-    if cfg.checkpoint.get("resume_from"):
-        cfg = resume_from_checkpoint(cfg)
-    if not cfg.get("run_name"):
-        cfg.run_name = _default_run_name(cfg)
-    check_configs(cfg)
-    if os.environ.get("SHEEPRL_TPU_QUIET", "0") != "1":
-        print_config(cfg)
-    run_algorithm(cfg)
+    multirun = False
+    for flag in ("-m", "--multirun"):
+        if flag in overrides:
+            multirun = True
+            overrides = [ov for ov in overrides if ov != flag]
+    jobs = expand_multirun(overrides) if multirun else [overrides]
+    if multirun and len(jobs) > 1:
+        stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+        print(f"multirun: {len(jobs)} jobs")
+    for i, job_overrides in enumerate(jobs):
+        cfg = compose(overrides=job_overrides)
+        if cfg.checkpoint.get("resume_from"):
+            cfg = resume_from_checkpoint(cfg)
+        if multirun and len(jobs) > 1:
+            base = cfg.get("run_name") or _default_run_name(cfg)
+            cfg.run_name = f"multirun_{stamp}/job{i}_{base}"
+            print(f"multirun job {i}/{len(jobs) - 1}: {' '.join(job_overrides)}")
+        elif not cfg.get("run_name"):
+            cfg.run_name = _default_run_name(cfg)
+        check_configs(cfg)
+        if os.environ.get("SHEEPRL_TPU_QUIET", "0") != "1":
+            print_config(cfg)
+        run_algorithm(cfg)
 
 
 def _load_checkpoint_cfg(overrides: List[str], path_key: str) -> tuple:

@@ -170,7 +170,11 @@ class DeviceReplayMirror:
         (the loops' step_data layout); ``positions[i]`` is env ``envs[i]``'s write
         cursor BEFORE the host add.  The update ships a full ``[n_envs]``-aligned
         row block with a write mask (static shapes, shard-local under dp>1);
-        unselected envs are masked no-ops."""
+        unselected envs are masked no-ops.  Shipping the full block costs host
+        memcpy + uplink for every env even on subset writes — the right trade at
+        current ``n_envs`` (one static scatter program); a compacted per-bucket
+        scatter only pays off if ``n_envs`` grows well past the env-farm sizes
+        the presets use."""
         env_sel = np.asarray(envs, np.intp)
         mask = np.zeros(self.n_envs, bool)
         mask[env_sel] = True
@@ -460,6 +464,12 @@ def sample_index_block(rb, batch_size: int, sequence_length: int, n: int, dp: in
     ``dp > 1``: the batch is drawn per data shard — element ``j`` (in shard
     ``j // (B//dp)``) samples only from the env block that shard owns, so the
     sharded gather never crosses shards.
+
+    Per-shard sampleability is guaranteed by the prefill gate (``cli.py``
+    ``check_configs``: learning_starts must leave EVERY env's sub-buffer a full
+    sequence) plus the loops' write pattern (every env appends a row every
+    iteration; done-index adds only append EXTRA rows) — so no shard's env block
+    can hold fewer rows than the gate checked, including after a resume.
     """
     if dp <= 1:
         idx = [rb.sample_idx(batch_size, sequence_length) for _ in range(n)]

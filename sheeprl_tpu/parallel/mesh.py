@@ -27,15 +27,22 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+_distributed_initialized = False
+
+
 def maybe_init_distributed(cfg: Dict[str, Any]) -> None:
-    """Initialise multi-host JAX when requested (replaces Fabric ``num_nodes``)."""
+    """Initialise multi-host JAX when requested (replaces Fabric ``num_nodes``).
+    Idempotent: ``jax.distributed.initialize`` may only run once per process, and
+    multirun sweeps call this once per job."""
+    global _distributed_initialized
     dist = cfg.get("distributed", {}) or {}
-    if dist.get("coordinator_address"):
+    if dist.get("coordinator_address") and not _distributed_initialized:
         jax.distributed.initialize(
             coordinator_address=dist["coordinator_address"],
             num_processes=dist.get("num_processes"),
             process_id=dist.get("process_id"),
         )
+        _distributed_initialized = True
 
 
 def build_mesh(

@@ -1,0 +1,51 @@
+"""``-m``/``--multirun`` grid sweeps (reference: Hydra multirun via ``cli.py:358``)."""
+
+import glob
+
+import pytest
+
+from sheeprl_tpu.cli import expand_multirun, run
+
+
+def test_expand_multirun_grid():
+    jobs = expand_multirun(["algo.lr=1e-4,3e-4", "seed=1,2", "exp=ppo"])
+    assert len(jobs) == 4
+    assert jobs[0] == ["algo.lr=1e-4", "seed=1", "exp=ppo"]
+    assert jobs[-1] == ["algo.lr=3e-4", "seed=2", "exp=ppo"]
+
+
+def test_expand_multirun_preserves_lists_and_singletons():
+    # bracketed values are single values, never sweep axes
+    jobs = expand_multirun(["algo.cnn_keys.encoder=[rgb,depth]", "seed=3"])
+    assert jobs == [["algo.cnn_keys.encoder=[rgb,depth]", "seed=3"]]
+    assert expand_multirun([]) == [[]]
+
+
+@pytest.mark.slow
+def test_multirun_composes_two_runs(tmp_path):
+    run(
+        [
+            "-m",
+            "exp=ppo_dummy",
+            "seed=1,2",
+            "dry_run=True",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.run_test=False",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "metric.log_every=1",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            f"log_root={tmp_path}",
+        ]
+    )
+    run_dirs = sorted(glob.glob(f"{tmp_path}/**/multirun_*/job*/version_0", recursive=True))
+    assert len(run_dirs) == 2, run_dirs
+    cfgs = [open(f"{d}/config.yaml").read() for d in run_dirs]
+    assert "seed: 1" in cfgs[0] and "seed: 2" in cfgs[1]
