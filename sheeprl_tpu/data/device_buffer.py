@@ -311,6 +311,13 @@ class MultiProcessDeviceReplayMirror(DeviceReplayMirror):
     """
 
     def __init__(self, capacity: int, n_envs_local: int, specs, global_mesh):
+        shape = dict(global_mesh.shape)
+        if shape.get("model", 1) > 1 or shape.get("sequence", 1) > 1:
+            raise ValueError(
+                "MultiProcessDeviceReplayMirror supports pure data parallelism only "
+                f"(got mesh {dict(global_mesh.shape)}) — the env ring has no model/"
+                "sequence dimension to shard over"
+            )
         block = _local_data_block(global_mesh)
         if block is None:
             raise ValueError("process's devices are not a contiguous block of the data axis")
