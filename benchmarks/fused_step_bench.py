@@ -19,8 +19,11 @@ Usage: ``python benchmarks/fused_step_bench.py [T] [B]`` (defaults 64, 16).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))  # repo root, after site pkgs resolve
 
 import jax
 import jax.numpy as jnp
