@@ -328,8 +328,11 @@ MIRROR_CHILD = textwrap.dedent(
         np.ascontiguousarray(envs, np.int32), np.ascontiguousarray(starts, np.int32)
     )
     gather = jax.jit(mirror.make_gather_fn(seq))
+    out0 = None
     for g in range(2):
         out = gather(mirror.global_view(), ge[g], gs[g])
+        if g == 0:
+            out0 = out
         # each process verifies ITS addressable batch columns against ITS host rows
         for k in ("rgb", "rewards"):
             arr = out[k]
@@ -344,6 +347,17 @@ MIRROR_CHILD = textwrap.dedent(
                     host = np.asarray(rb.buffer[e]._buf[k])[:, 0].reshape(cap, *data.shape[2:])
                     expect = np.stack([host[(st + i) % cap] for i in range(seq)])
                     np.testing.assert_array_equal(data[:, col], expect, err_msg=f"{{k}} b={{b_global}}")
+
+    # resume path: a FRESH MP mirror rebuilt from the host buffer must hold the
+    # same local rows (each process restores its own shard independently)
+    rebuilt = MultiProcessDeviceReplayMirror(cap, n_envs, specs, global_mesh=mesh)
+    rebuilt.load_from(rb)
+    for k in ("rgb", "rewards"):
+        np.testing.assert_array_equal(rebuilt.host_rows(k), mirror.host_rows(k), err_msg=f"load_from {{k}}")
+    out2 = gather(rebuilt.global_view(), ge[0], gs[0])
+    for k in ("rgb", "rewards"):
+        for s_new, s_old in zip(out2[k].addressable_shards, out0[k].addressable_shards):
+            np.testing.assert_array_equal(np.asarray(s_new.data), np.asarray(s_old.data), err_msg=f"resume gather {{k}}")
     print(f"mirror child {{pid}} OK", flush=True)
     """
 ).format(repo=str(REPO))
