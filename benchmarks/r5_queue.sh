@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-5 chip-job queue: run the remaining learning runs back-to-back on the one
+# TPU chip, newest evidence first, and stop launching new jobs after the cutoff so
+# the chip is free for the end-of-round bench.
+#
+# Usage: bash benchmarks/r5_queue.sh <cutoff_epoch_seconds>
+
+set -u
+cd /root/repo
+CUTOFF=${1:?usage: r5_queue.sh <cutoff_epoch>}
+export MUJOCO_GL=egl
+
+run_if_time() { # name estimated_minutes command...
+    local name=$1 est=$2; shift 2
+    local now=$(date +%s)
+    if (( now + est * 60 > CUTOFF )); then
+        echo "[$name] SKIPPED: $(date -u) + ${est}m would pass cutoff" | tee -a logs/r5_queue.log
+        return 1
+    fi
+    echo "[$name] START $(date -u)" | tee -a logs/r5_queue.log
+    "$@" > "logs/${name}_stdout.log" 2>&1
+    local rc=$?
+    echo "[$name] END rc=$rc $(date -u)" | tee -a logs/r5_queue.log
+    return 0
+}
+
+# 1. P2E-DV3 exploration on the sparse task (~150K frames).
+run_if_time p2e_expl_r5 55 \
+    python -m sheeprl_tpu exp=p2e_dv3_expl_dmc_cartpole_swingup_sparse \
+    buffer.device=True mesh.devices=1 seed=42 \
+    run_name=p2e_expl_r5 log_root=/root/repo/logs/p2e_expl_r5
+
+# 2. P2E-DV3 finetuning from the exploration checkpoint (~200K frames).
+EXPL_CKPT=$(ls -d logs/p2e_expl_r5/runs/*/*/*/version_0/checkpoints/ckpt_* 2>/dev/null | sort -V | tail -1)
+if [ -n "${EXPL_CKPT:-}" ]; then
+    run_if_time p2e_fntn_r5 70 \
+        python -m sheeprl_tpu exp=p2e_dv3_fntn_dmc_cartpole_swingup_sparse \
+        buffer.device=True mesh.devices=1 seed=42 \
+        "checkpoint.exploration_ckpt_path=/root/repo/$EXPL_CKPT" \
+        run_name=p2e_fntn_r5 log_root=/root/repo/logs/p2e_fntn_r5
+else
+    echo "[p2e_fntn_r5] SKIPPED: no exploration checkpoint found" | tee -a logs/r5_queue.log
+fi
+
+# 3. DreamerV2 reward learning on cartpole_swingup pixels (~300K frames).
+run_if_time dv2_cartpole_r5 95 \
+    python -m sheeprl_tpu exp=dreamer_v2 env=dmc env.id=cartpole_swingup \
+    env.num_envs=4 env.action_repeat=2 env.max_episode_steps=-1 \
+    algo.total_steps=150000 "algo.cnn_keys.encoder=[rgb]" "algo.mlp_keys.encoder=[]" \
+    buffer.size=500000 buffer.checkpoint=True buffer.device=True mesh.devices=1 \
+    metric.log_every=2000 checkpoint.every=50000 seed=42 \
+    run_name=dv2_cartpole_r5 log_root=/root/repo/logs/dv2_cartpole_r5
+
+# 4. DreamerV1 reward learning on cartpole_swingup pixels (~300K frames).
+run_if_time dv1_cartpole_r5 95 \
+    python -m sheeprl_tpu exp=dreamer_v1 env=dmc env.id=cartpole_swingup \
+    env.num_envs=4 env.action_repeat=2 env.max_episode_steps=-1 \
+    algo.total_steps=150000 "algo.cnn_keys.encoder=[rgb]" "algo.mlp_keys.encoder=[]" \
+    buffer.size=500000 buffer.checkpoint=True buffer.device=True mesh.devices=1 \
+    metric.log_every=2000 checkpoint.every=50000 seed=42 \
+    run_name=dv1_cartpole_r5 log_root=/root/repo/logs/dv1_cartpole_r5
+
+echo "[queue] DONE $(date -u)" | tee -a logs/r5_queue.log
