@@ -48,7 +48,11 @@ def read_run(version_dir: str) -> dict:
 
 
 def latest_version(pattern: str):
-    runs = sorted(glob.glob(pattern, recursive=True))
+    def version_num(path: str) -> int:
+        tail = path.rstrip("/").rsplit("_", 1)[-1]
+        return int(tail) if tail.isdigit() else -1
+
+    runs = sorted(glob.glob(pattern, recursive=True), key=lambda p: (version_num(p), p))
     return runs[-1] if runs else None
 
 

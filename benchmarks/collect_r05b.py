@@ -1,0 +1,97 @@
+"""Merge the round-5b learning runs (A2C, PPO-recurrent masked, DroQ, SAC-AE)
+into ``LEARNING_r05.json`` ``additional_runs``.
+
+Unlike ``collect_r05.py`` (which rebuilds the file from ``logs/``), this script
+*merges*: the committed walker replication and P2E/DV1/DV2 entries are kept
+as-is (their run dirs may have been cleaned), and each r5b run found under
+``logs/`` is appended — replacing any earlier entry with the same label, so
+reruns are safe.
+
+Usage::
+
+    python benchmarks/collect_r05b.py [LEARNING_r05.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from collect_r05 import latest_version, read_run  # noqa: E402
+
+COMMANDS = {
+    "a2c_cartpole_r5": (
+        "python -m sheeprl_tpu exp=a2c env.id=CartPole-v1 algo.mlp_keys.encoder=[state] "
+        "algo.cnn_keys.encoder=[] algo.total_steps=262144 env.num_envs=4 seed=42"
+    ),
+    "ppo_rec_mask_r5": (
+        "python -m sheeprl_tpu exp=ppo_recurrent env.id=CartPole-v1 "
+        "algo.mlp_keys.encoder=[state] algo.cnn_keys.encoder=[] "
+        "env.mask_velocities=True algo.total_steps=262144 env.num_envs=4 seed=42"
+    ),
+    "droq_cheetah_r5": (
+        "MUJOCO_GL=egl python -m sheeprl_tpu exp=droq algo.total_steps=100000 "
+        "algo.mlp_keys.encoder=[state] algo.cnn_keys.encoder=[] "
+        "env.num_envs=4 buffer.size=100000 seed=42"
+    ),
+    "sac_ae_cartpole_r5": (
+        "MUJOCO_GL=egl python -m sheeprl_tpu exp=sac_ae env.id=cartpole_swingup "
+        "env.num_envs=4 env.action_repeat=8 env.max_episode_steps=-1 "
+        "algo.total_steps=62500 algo.cnn_keys.encoder=[rgb] algo.mlp_keys.encoder=[] "
+        "buffer.size=100000 buffer.checkpoint=True seed=42"
+    ),
+}
+NOTES = {
+    "a2c_cartpole_r5": (
+        "A2C reward learning on CartPole-v1 states (64-unit tanh MLPs, RMSpropTF); "
+        "500 is the env maximum"
+    ),
+    "ppo_rec_mask_r5": (
+        "PPO-recurrent on VELOCITY-MASKED CartPole: the observation hides velocities, "
+        "so above-random reward requires the LSTM to integrate position history — "
+        "the recurrence is load-bearing, not decorative"
+    ),
+    "droq_cheetah_r5": (
+        "DroQ on its native HalfCheetah-v4 (gym states), replay_ratio 20 + dropout "
+        "critics: the utd-20 sample-efficiency regime the paper targets"
+    ),
+    "sac_ae_cartpole_r5": (
+        "SAC-AE from pixels on cartpole_swingup (paper hyperparams: action_repeat 8, "
+        "deterministic AE regulariser), 500K env frames"
+    ),
+}
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "LEARNING_r05.json"
+    root = os.path.dirname(os.path.abspath(__file__)) + "/../logs"
+
+    with open(out_path) as f:
+        out = json.load(f)
+    additional = out.setdefault("additional_runs", [])
+
+    for name in COMMANDS:
+        d = latest_version(f"{root}/{name}/runs/**/version_*")
+        if not d:
+            print(f"no run dir for {name}", file=sys.stderr)
+            continue
+        try:
+            run = read_run(d)
+        except Exception as exc:
+            print(f"skip {name}: {exc}", file=sys.stderr)
+            continue
+        run["label"] = name
+        run["command"] = COMMANDS[name]
+        run["notes"] = NOTES[name]
+        additional[:] = [r for r in additional if r.get("label") != name]
+        additional.append(run)
+
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps([(r["label"], r["final_test_reward"]) for r in additional], indent=1))
+    print(f"-> {out_path}")
+
+
+if __name__ == "__main__":
+    main()
