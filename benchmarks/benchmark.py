@@ -19,11 +19,6 @@ import time
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    if os.environ.get("JAX_PLATFORMS"):
-        # Honor the env var even where site config pins the platform at startup.
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     from sheeprl_tpu.cli import run
 
     args = sys.argv[1:]

@@ -22,22 +22,22 @@ from collect_r05 import latest_version, read_run  # noqa: E402
 
 COMMANDS = {
     "a2c_cartpole_r5": (
-        "python -m sheeprl_tpu exp=a2c env.id=CartPole-v1 algo.mlp_keys.encoder=[state] "
-        "algo.cnn_keys.encoder=[] algo.total_steps=262144 env.num_envs=4 seed=42"
+        "JAX_PLATFORMS=cpu python -m sheeprl_tpu exp=a2c env.id=CartPole-v1 algo.mlp_keys.encoder=[state] "
+        "algo.cnn_keys.encoder=[] algo.total_steps=262144 env.num_envs=4 env.sync_env=True seed=42"
     ),
     "ppo_rec_mask_r5": (
-        "python -m sheeprl_tpu exp=ppo_recurrent env.id=CartPole-v1 "
+        "JAX_PLATFORMS=cpu python -m sheeprl_tpu exp=ppo_recurrent env.id=CartPole-v1 "
         "algo.mlp_keys.encoder=[state] algo.cnn_keys.encoder=[] "
-        "env.mask_velocities=True algo.total_steps=262144 env.num_envs=4 seed=42"
+        "env.mask_velocities=True algo.total_steps=262144 env.num_envs=4 env.sync_env=True seed=42"
     ),
     "droq_cheetah_r5": (
-        "MUJOCO_GL=egl python -m sheeprl_tpu exp=droq algo.total_steps=100000 "
+        "MUJOCO_GL=egl python -m sheeprl_tpu exp=droq algo.total_steps=50000 "
         "algo.mlp_keys.encoder=[state] algo.cnn_keys.encoder=[] "
-        "env.num_envs=4 buffer.size=100000 seed=42"
+        "env.num_envs=4 env.sync_env=True buffer.size=100000 seed=42"
     ),
     "sac_ae_cartpole_r5": (
         "MUJOCO_GL=egl python -m sheeprl_tpu exp=sac_ae env.id=cartpole_swingup "
-        "env.num_envs=4 env.action_repeat=8 env.max_episode_steps=-1 "
+        "env.num_envs=4 env.sync_env=True env.action_repeat=8 env.max_episode_steps=-1 "
         "algo.total_steps=62500 algo.cnn_keys.encoder=[rgb] algo.mlp_keys.encoder=[] "
         "buffer.size=100000 buffer.checkpoint=True seed=42"
     ),
@@ -45,16 +45,18 @@ COMMANDS = {
 NOTES = {
     "a2c_cartpole_r5": (
         "A2C reward learning on CartPole-v1 states (64-unit tanh MLPs, RMSpropTF); "
-        "500 is the env maximum"
+        "500 is the env maximum. Host-CPU run: per-step policy calls on tiny MLPs "
+        "are chip-tunnel-RTT-bound, so state-based on-policy runs stay on host"
     ),
     "ppo_rec_mask_r5": (
         "PPO-recurrent on VELOCITY-MASKED CartPole: the observation hides velocities, "
         "so above-random reward requires the LSTM to integrate position history — "
-        "the recurrence is load-bearing, not decorative"
+        "the recurrence is load-bearing, not decorative. Host-CPU run (see a2c note)"
     ),
     "droq_cheetah_r5": (
         "DroQ on its native HalfCheetah-v4 (gym states), replay_ratio 20 + dropout "
-        "critics: the utd-20 sample-efficiency regime the paper targets"
+        "critics: the utd-20 sample-efficiency regime the paper targets; 50K env steps "
+        "on the chip (the per-step 80-update scanned block amortizes the tunnel RTT)"
     ),
     "sac_ae_cartpole_r5": (
         "SAC-AE from pixels on cartpole_swingup (paper hyperparams: action_repeat 8, "

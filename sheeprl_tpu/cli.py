@@ -22,6 +22,20 @@ from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry, 
 from sheeprl_tpu.utils.timer import timer
 
 
+def _honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu python -m sheeprl_tpu ...`` actually select the
+    platform.  Accelerator images may pin ``jax_platforms`` from ``sitecustomize``
+    at interpreter start, which silently wins over the environment variable; state
+    -based runs whose per-step policy calls would otherwise pay a device round
+    trip per env step need a working CPU escape hatch.  Must run before the first
+    backend initialisation (i.e. before mesh setup touches ``jax.devices``)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
 def _import_algorithms() -> None:
     """Populate the registries (reference imports every algo in ``sheeprl/__init__.py:18-47``)."""
     import sheeprl_tpu.algos  # noqa: F401  (registers everything on import)
@@ -181,6 +195,7 @@ def run(args: Optional[List[str]] = None) -> None:
     (sequential execution), mirroring the reference's Hydra multirun: each job's
     ``run_name`` gains a ``multirun_<stamp>/job<i>`` prefix so the sweep lands in
     one directory tree."""
+    _honor_platform_env()
     _import_algorithms()
     overrides = list(args if args is not None else sys.argv[1:])
     multirun = False
@@ -240,6 +255,7 @@ def _load_checkpoint_cfg(overrides: List[str], path_key: str) -> tuple:
 
 def evaluate(args: Optional[List[str]] = None) -> None:
     """Eval entry: ``python -m sheeprl_tpu.eval checkpoint_path=... [overrides]``"""
+    _honor_platform_env()
     _import_algorithms()
     overrides = list(args if args is not None else sys.argv[1:])
     cfg, ckpt_path = _load_checkpoint_cfg(overrides, "checkpoint_path")
