@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Fold a Chrome-trace JSON (exported by ``sheeprl_tpu.obs``) into a per-phase table.
+
+Usage:
+    python benchmarks/trace_summary.py <log_dir>/trace.json [--json]
+
+Per span name: call count, total time, share of the top-level (depth-0) wall clock, and
+p50/p95/p99 latencies.  ``--json`` emits the same table as a JSON object for BENCH
+report collection scripts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def summarize(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    phases: Dict[str, List[float]] = {}
+    top_level_total = 0.0
+    for e in events:
+        dur_ms = float(e.get("dur", 0.0)) / 1e3
+        phases.setdefault(e["name"], []).append(dur_ms)
+        if e.get("args", {}).get("depth", 0) == 0:
+            top_level_total += dur_ms
+    rows = {}
+    for name, durs in phases.items():
+        durs = sorted(durs)
+
+        def pct(q: float) -> float:
+            if len(durs) == 1:
+                return durs[0]
+            idx = q / 100.0 * (len(durs) - 1)
+            lo = int(idx)
+            hi = min(lo + 1, len(durs) - 1)
+            return durs[lo] + (durs[hi] - durs[lo]) * (idx - lo)
+
+        total = sum(durs)
+        rows[name] = {
+            "count": len(durs),
+            "total_ms": total,
+            "share": total / top_level_total if top_level_total > 0 else 0.0,
+            "p50_ms": pct(50),
+            "p95_ms": pct(95),
+            "p99_ms": pct(99),
+        }
+    return {
+        "trace": path,
+        "top_level_total_ms": top_level_total,
+        "phases": dict(sorted(rows.items(), key=lambda kv: -kv[1]["total_ms"])),
+    }
+
+
+def format_table(summary: Dict[str, Any]) -> str:
+    headers = ("phase", "count", "total_ms", "share", "p50_ms", "p95_ms", "p99_ms")
+    rows = [
+        (
+            name,
+            str(r["count"]),
+            f"{r['total_ms']:.2f}",
+            f"{r['share'] * 100:.1f}%",
+            f"{r['p50_ms']:.3f}",
+            f"{r['p95_ms']:.3f}",
+            f"{r['p99_ms']:.3f}",
+        )
+        for name, r in summary["phases"].items()
+    ]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h) for i, h in enumerate(headers)]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+        "-" * (sum(widths) + 2 * (len(widths) - 1)),
+    ]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    lines.append(f"top-level wall clock: {summary['top_level_total_ms']:.2f} ms")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("trace", help="Chrome-trace JSON file (e.g. <log_dir>/trace.json)")
+    parser.add_argument("--json", action="store_true", help="emit JSON instead of a table")
+    args = parser.parse_args(argv)
+    summary = summarize(args.trace)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(format_table(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ from sheeprl_tpu.algos.ppo.utils import log_prob_and_entropy, prepare_obs, sampl
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -40,6 +41,7 @@ def main(ctx, cfg) -> None:
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
 
     envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -121,6 +123,7 @@ def main(ctx, cfg) -> None:
     step_data: Dict[str, np.ndarray] = {}
 
     for update in range(start_update, num_updates + 1):
+        monitor.advance()
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
@@ -182,7 +185,7 @@ def main(ctx, cfg) -> None:
             metrics = aggregator.compute()
             metrics["Time/sps_train"] = 1.0 / train_time if train_time > 0 else 0.0
             metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
-            logger.log_metrics(metrics, policy_step)
+            monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step
 
@@ -205,6 +208,7 @@ def main(ctx, cfg) -> None:
             )
             last_checkpoint = policy_step
 
+    monitor.close()
     envs.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(agent, params, ctx, cfg, log_dir)

@@ -56,6 +56,7 @@ from sheeprl_tpu.distributions import (
     SymlogDistribution,
     TwoHotEncodingDistribution,
 )
+from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, make_aggregator, record_episode_stats
@@ -328,6 +329,7 @@ def main(ctx, cfg) -> None:
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
 
     envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -475,6 +477,7 @@ def main(ctx, cfg) -> None:
 
     try:
         for iter_num in range(start_iter, num_iters + 1):
+            monitor.advance()
             env_time = 0.0
             env_t0 = time.perf_counter()
             with timer("Time/env_interaction_time"), timer("Time/phase_player"):
@@ -632,11 +635,12 @@ def main(ctx, cfg) -> None:
                 metrics["Params/replay_ratio"] = (
                     cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
                 )
-                logger.log_metrics(metrics, policy_step)
+                monitor.log_metrics(logger, metrics, policy_step)
                 aggregator.reset()
                 last_log = policy_step
 
     finally:
+        monitor.close()
         envs.close()
         if prefetcher is not None:
             prefetcher.close()

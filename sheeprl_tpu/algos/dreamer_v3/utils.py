@@ -8,6 +8,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.obs.tracer import trace_span
+
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
     "Game/ep_len_avg",
@@ -55,6 +57,7 @@ def update_moments(
     return new_low, invscale, {"low": new_low, "high": new_high}
 
 
+@trace_span("Time/h2d_transfer")
 def prepare_obs(
     obs: Dict[str, np.ndarray], cnn_keys: Sequence[str], mlp_keys: Sequence[str], num_envs: int = 1
 ) -> Dict[str, jax.Array]:

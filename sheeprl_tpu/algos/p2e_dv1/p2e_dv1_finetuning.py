@@ -23,6 +23,7 @@ from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_device_replay
+from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -41,6 +42,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
 
     envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -181,6 +183,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
     prefill_iters = max(learning_starts - 1, 0)
 
     for iter_num in range(start_iter, num_iters + 1):
+        monitor.advance()
         env_t0 = time.perf_counter()
         expl_amount = exploration_amount(
             expl_cfg.get("expl_amount", 0.0), expl_cfg.get("expl_decay", 0.0), expl_cfg.get("expl_min", 0.0), policy_step
@@ -280,7 +283,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             metrics["Params/replay_ratio"] = (
                 cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
             )
-            logger.log_metrics(metrics, policy_step)
+            monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step
 
@@ -312,6 +315,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             ckpt_manager.save(policy_step, ckpt_state)
             last_checkpoint = policy_step
 
+    monitor.close()
     envs.close()
     if prefetcher is not None:
         prefetcher.close()

@@ -38,6 +38,7 @@ from sheeprl_tpu.algos.ppo.utils import (
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -175,6 +176,7 @@ def main(ctx, cfg) -> None:
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
 
     envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -237,6 +239,7 @@ def main(ctx, cfg) -> None:
     start_time = time.perf_counter()
 
     for update in range(start_update, num_updates + 1):
+        monitor.advance()
         train_time = 0.0
         env_time_start = time.perf_counter()
         with timer("Time/env_interaction_time"):
@@ -326,7 +329,7 @@ def main(ctx, cfg) -> None:
             metrics["Params/lr"] = (
                 float(lr_schedule(grad_step_count)) if lr_schedule is not None else float(cfg.algo.optimizer.lr)
             )
-            logger.log_metrics(metrics, policy_step)
+            monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step
 
@@ -349,6 +352,7 @@ def main(ctx, cfg) -> None:
             )
             last_checkpoint = policy_step
 
+    monitor.close()
     envs.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(agent, params, ctx, cfg, log_dir)

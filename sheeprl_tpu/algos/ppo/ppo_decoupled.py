@@ -42,6 +42,7 @@ from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -57,6 +58,7 @@ def main(ctx, cfg) -> None:
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
 
     envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -215,6 +217,7 @@ def main(ctx, cfg) -> None:
     policy_step = policy_step0
     try:
         for update in range(start_update, num_updates + 1):
+            monitor.advance()
             item = rollout_q.get()
             if isinstance(item, Exception):
                 raise item
@@ -257,7 +260,7 @@ def main(ctx, cfg) -> None:
                     if fns.lr_schedule is not None
                     else float(cfg.algo.optimizer.lr)
                 )
-                logger.log_metrics(metrics, policy_step)
+                monitor.log_metrics(logger, metrics, policy_step)
                 last_log = policy_step
 
             if (
@@ -281,6 +284,7 @@ def main(ctx, cfg) -> None:
     finally:
         stop.set()
         player_thread.join(timeout=30)
+        monitor.close()
 
     if player_thread.is_alive():
         # The player is stuck inside envs.step(); closing the envs under it would

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.distributions import Categorical, Normal
+from sheeprl_tpu.obs.tracer import trace_span
 
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
@@ -20,6 +21,7 @@ AGGREGATOR_KEYS = {
 MODELS_TO_REGISTER = {"agent"}
 
 
+@trace_span("Time/h2d_transfer")
 def prepare_obs(obs: Dict[str, np.ndarray], cnn_keys: Sequence[str], mlp_keys: Sequence[str]) -> Dict[str, jax.Array]:
     """numpy env observations → device arrays (uint8 images stay uint8; the encoder
     normalises on device, reference ``utils.py:…prepare_obs``)."""

@@ -24,6 +24,7 @@ from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, build_agent
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -52,6 +53,7 @@ def main(ctx, cfg) -> None:
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
 
     envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -173,6 +175,7 @@ def main(ctx, cfg) -> None:
     step_data: Dict[str, np.ndarray] = {}
 
     for update in range(start_update, num_updates + 1):
+        monitor.advance()
         if is_attention:
             # The attention context never crosses a rollout boundary: training
             # attends within the rollout only, so acting resets its window here —
@@ -278,7 +281,7 @@ def main(ctx, cfg) -> None:
                 cfg.algo.update_epochs * num_batches / train_time if train_time > 0 else 0.0
             )
             metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
-            logger.log_metrics(metrics, policy_step)
+            monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step
 
@@ -301,6 +304,7 @@ def main(ctx, cfg) -> None:
             )
             last_checkpoint = policy_step
 
+    monitor.close()
     envs.close()
     if cfg.algo.run_test and ctx.is_global_zero:
         reward = test(agent, params, ctx, cfg, log_dir)

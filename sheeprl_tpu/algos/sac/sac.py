@@ -28,6 +28,7 @@ from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -138,6 +139,7 @@ def main(ctx, cfg) -> None:
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
 
     envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -254,6 +256,7 @@ def main(ctx, cfg) -> None:
         cumulative_grad_steps += grad_steps
 
     for iter_num in range(start_iter, num_iters + 1):
+        monitor.advance()
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
             # A resumed run already has a trained policy — don't replay the random
@@ -333,7 +336,7 @@ def main(ctx, cfg) -> None:
             metrics["Params/replay_ratio"] = (
                 cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
             )
-            logger.log_metrics(metrics, policy_step)
+            monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step
 
@@ -358,6 +361,7 @@ def main(ctx, cfg) -> None:
             ckpt_manager.save(policy_step, state)
             last_checkpoint = policy_step
 
+    monitor.close()
     envs.close()
     if prefetcher is not None:
         prefetcher.close()

@@ -32,6 +32,7 @@ from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -47,6 +48,7 @@ def main(ctx, cfg) -> None:
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
 
     envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -232,6 +234,7 @@ def main(ctx, cfg) -> None:
     policy_step = policy_step0
     try:
         for iter_num in range(start_iter, num_iters + 1):
+            monitor.advance()
             item = batch_q.get()
             if isinstance(item, Exception):
                 raise item
@@ -275,7 +278,7 @@ def main(ctx, cfg) -> None:
                 metrics["Params/replay_ratio"] = (
                     cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
                 )
-                logger.log_metrics(metrics, policy_step)
+                monitor.log_metrics(logger, metrics, policy_step)
                 last_log = policy_step
 
             if item["ckpt"] is not None:
@@ -296,6 +299,7 @@ def main(ctx, cfg) -> None:
     finally:
         stop.set()
         player_thread.join(timeout=30)
+        monitor.close()
 
     if player_thread.is_alive():
         raise RuntimeError("decoupled player thread did not shut down cleanly")

@@ -8,6 +8,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.obs.tracer import trace_span
+
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
     "Game/ep_len_avg",
@@ -18,6 +20,7 @@ AGGREGATOR_KEYS = {
 MODELS_TO_REGISTER = {"agent"}
 
 
+@trace_span("Time/h2d_transfer")
 def prepare_obs(obs: Dict[str, np.ndarray], mlp_keys: Sequence[str]) -> jax.Array:
     """Concatenate (flattened) vector keys: SAC is vector-obs only (reference parity)."""
     arrs = [np.asarray(obs[k], dtype=np.float32) for k in mlp_keys]

@@ -29,6 +29,7 @@ from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import DeviceReplayMirror, device_replay_enabled
 from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -54,6 +55,7 @@ def main(ctx, cfg) -> None:
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
 
     envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -363,6 +365,7 @@ def main(ctx, cfg) -> None:
         cumulative_grad_steps += grad_steps
 
     for iter_num in range(start_iter, num_iters + 1):
+        monitor.advance()
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts:
@@ -439,7 +442,7 @@ def main(ctx, cfg) -> None:
                 metrics["Time/sps_train"] = window_sps
             metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
             metrics["Params/replay_ratio"] = cumulative_grad_steps * world / policy_step if policy_step else 0.0
-            logger.log_metrics(metrics, policy_step)
+            monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step
 
@@ -464,6 +467,7 @@ def main(ctx, cfg) -> None:
             ckpt_manager.save(policy_step, state)
             last_checkpoint = policy_step
 
+    monitor.close()
     envs.close()
     if prefetcher is not None:
         prefetcher.close()

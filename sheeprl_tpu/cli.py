@@ -33,6 +33,22 @@ def _honor_platform_env() -> None:
     if plat:
         import jax
 
+        try:
+            already_initialized = bool(getattr(jax._src.xla_bridge, "_backends", None))
+        except Exception:
+            already_initialized = False
+        requested = [p.strip() for p in plat.split(",") if p.strip()]
+        if already_initialized and jax.default_backend() not in requested:
+            # Too late to honor the request: some import (sitecustomize, a plugin, an
+            # eager device query) already initialised a backend, and jax_platforms is
+            # read only at first initialisation.  Warn instead of failing silently.
+            warnings.warn(
+                f"JAX_PLATFORMS={plat!r} is set but a JAX backend is already initialized "
+                f"(devices on {jax.default_backend()!r}); the platform request may be "
+                "ignored for this run. Set JAX_PLATFORMS before anything imports and "
+                "uses JAX (e.g. avoid eager jax.devices() calls in sitecustomize).",
+                stacklevel=2,
+            )
         jax.config.update("jax_platforms", plat)
 
 
@@ -76,6 +92,15 @@ def check_configs(cfg: DotDict) -> None:
         raise ValueError("algo.cnn_keys.encoder and algo.mlp_keys.encoder must be lists")
     if cfg.metric.get("log_level", 1) not in (0, 1):
         raise ValueError(f"Invalid metric.log_level: {cfg.metric.log_level}")
+    capture = cfg.get("obs", {}).get("capture_steps")
+    if capture is not None:
+        if not (isinstance(capture, (list, tuple)) and len(capture) == 2):
+            raise ValueError(f"obs.capture_steps must be [start_update, end_update]; got {capture!r}")
+        start, end = int(capture[0]), int(capture[1])
+        if start < 1 or end < start:
+            raise ValueError(
+                f"obs.capture_steps window must satisfy 1 <= start <= end; got [{start}, {end}]"
+            )
     # DV1/DV2 (and their P2E variants) pin the decoder geometry to 64×64 single-frame
     # (reference dreamer_v2.py:399-400).  Validate instead of silently overwriting the
     # user's config, so the saved config.yaml never contradicts the CLI.

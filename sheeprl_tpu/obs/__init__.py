@@ -1,0 +1,33 @@
+"""Unified training observability: span tracer, XProf integration, device telemetry.
+
+Layers (bottom-up):
+
+* ``tracer``    — hierarchical span tracer (context manager + decorator), Chrome-trace/
+                  Perfetto JSON export, per-span latency histograms;
+* ``telemetry`` — ``Memory/*`` gauges from ``Device.memory_stats()`` with a host-RSS
+                  fallback on CPU backends;
+* ``watchdog``  — ``Compile/*`` counters + loud warnings on post-warmup recompiles;
+* ``monitor``   — ``TrainingMonitor``, the per-algorithm facade tying it together and
+                  driving ``jax.profiler`` step annotations / capture windows.
+
+Import note: ``utils.timer`` imports ``obs.tracer`` at module load so every existing
+``with timer(...)`` block doubles as a span — nothing in this package may import
+``utils.timer``, and JAX is only imported lazily inside methods.
+"""
+
+from sheeprl_tpu.obs.monitor import TrainingMonitor
+from sheeprl_tpu.obs.telemetry import DeviceTelemetry
+from sheeprl_tpu.obs.tracer import SpanTracer, get_active, set_active, span, trace_span
+from sheeprl_tpu.obs.watchdog import RecompileWarning, RecompileWatchdog
+
+__all__ = [
+    "TrainingMonitor",
+    "DeviceTelemetry",
+    "SpanTracer",
+    "RecompileWarning",
+    "RecompileWatchdog",
+    "get_active",
+    "set_active",
+    "span",
+    "trace_span",
+]

@@ -1,0 +1,240 @@
+"""``TrainingMonitor``: one object that wires the whole observability stack into an
+algorithm loop with a ~3-line change.
+
+    monitor = TrainingMonitor(cfg, log_dir)          # after get_logger(...)
+    ...
+    for update in ...:
+        monitor.advance(policy_step)                 # top of every update
+        ...
+        monitor.log_metrics(logger, metrics, step)   # instead of logger.log_metrics
+    ...
+    monitor.close()                                  # before the loop's teardown
+
+Per update, ``advance`` (a) rolls the ``jax.profiler.StepTraceAnnotation`` so XProf
+traces show one slice per training update, (b) drives the programmatic XProf capture
+window (``obs.capture_steps=[N, M]`` → ``<log_dir>/xprof``), (c) polls device/host
+memory telemetry, and (d) after warmup arms the recompile watchdog and warns loudly on
+every post-warmup jit cache miss.  The span tracer itself is fed by the ``timer``
+context managers already present in every loop (see ``utils/timer.py``), so phase spans
+(env interaction, h2d transfer, train step, logging) need no extra per-algo code.
+
+``obs.enabled=false`` short-circuits every method on its first line: the monitor adds
+one attribute check per update and nothing else.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.obs import tracer as _tracer
+from sheeprl_tpu.obs.telemetry import DeviceTelemetry
+from sheeprl_tpu.obs.tracer import SpanTracer
+from sheeprl_tpu.obs.watchdog import RecompileWarning, RecompileWatchdog
+
+_UPDATE_SPAN = "Time/update"
+_LOG_SPAN = "Time/log"
+
+
+class TrainingMonitor:
+    def __init__(self, cfg: Dict[str, Any], log_dir: str, rank: Optional[int] = None):
+        obs_cfg = dict(cfg.get("obs", {}) or {})
+        self.enabled: bool = bool(obs_cfg.get("enabled", False))
+        self.log_dir = log_dir
+        self._updates = 0
+        self._closed = False
+        self.tracer: Optional[SpanTracer] = None
+        self._telemetry: Optional[DeviceTelemetry] = None
+        self._watchdog: Optional[RecompileWatchdog] = None
+        if not self.enabled:
+            return
+
+        if rank is None:
+            import jax
+
+            rank = jax.process_index()
+        self.rank = int(rank)
+
+        # Validate everything that can raise BEFORE taking side effects (installing the
+        # global tracer, registering the jax.monitoring listener) so a bad config
+        # cannot leak process-global state.
+        capture = obs_cfg.get("capture_steps")
+        self._capture = None
+        if capture:
+            start, end = int(capture[0]), int(capture[1])
+            if start < 1 or end < start:
+                raise ValueError(f"obs.capture_steps must be [start>=1, end>=start]; got {capture!r}")
+            self._capture = (start, end)
+        self._capturing = False
+
+        self._xprof = bool(obs_cfg.get("xprof_annotations", True))
+        self._annotation = None
+        self._warmup_updates = max(int(obs_cfg.get("warmup_updates", 1)), 0)
+        self._telemetry_latest: Dict[str, float] = {}
+
+        self._trace = bool(obs_cfg.get("trace", True))
+        self._prev_tracer = None
+        if self._trace:
+            self.tracer = SpanTracer(rank=self.rank, max_events=int(obs_cfg.get("max_events", 100_000)))
+            self._prev_tracer = _tracer.set_active(self.tracer)
+
+        if bool(obs_cfg.get("telemetry", True)):
+            self._telemetry = DeviceTelemetry(interval_s=float(obs_cfg.get("telemetry_interval", 10.0)))
+
+        if bool(obs_cfg.get("watchdog", True)):
+            self._watchdog = RecompileWatchdog()
+
+        self._host_tracer_level = int(obs_cfg.get("host_tracer_level", 0))
+        self._session = None
+
+    # ------------------------------------------------------------------ per update
+    def advance(self, policy_step: Optional[int] = None) -> None:
+        """Call once at the top of every training update."""
+        if not self.enabled:
+            return
+        self._updates += 1
+        update = self._updates
+
+        if self.tracer is not None:
+            if update > 1:
+                self.tracer.end(_UPDATE_SPAN)
+            self.tracer.begin(_UPDATE_SPAN)
+
+        # Close the previous update's StepTraceAnnotation BEFORE moving the capture
+        # window, and open the next one AFTER: every annotation must nest strictly
+        # inside the profiler session (TraceMe handles straddling a start_trace/
+        # stop_trace boundary poorly — observed as a native crash when third-party
+        # render threads are alive).
+        if self._xprof and self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
+
+        if self._capture is not None:
+            start, end = self._capture
+            if update == start and not self._capturing:
+                self._start_capture()
+            elif update == end + 1 and self._capturing:
+                self._stop_capture()
+
+        if self._xprof:
+            import jax
+
+            self._annotation = jax.profiler.StepTraceAnnotation("train", step_num=update)
+            self._annotation.__enter__()
+
+        if self._watchdog is not None:
+            if update == self._warmup_updates + 1:
+                self._watchdog.mark_warm()
+            elif update > self._warmup_updates + 1:
+                n = self._watchdog.poll_new()
+                if n:
+                    warnings.warn(
+                        f"{n} post-warmup XLA recompilation(s) detected at update {update - 1} "
+                        f"(total={self._watchdog.total_compiles}): a jitted function's input "
+                        "shapes/dtypes or captured constants are changing between updates, which "
+                        "silently destroys throughput. Check Compile/recompiles and capture an "
+                        "XProf window (obs.capture_steps) around this update.",
+                        RecompileWarning,
+                        stacklevel=2,
+                    )
+
+        if self._telemetry is not None:
+            polled = self._telemetry.poll()
+            if polled:
+                self._telemetry_latest = polled
+
+    # ------------------------------------------------------------------ metrics/logging
+    def span(self, name: str):
+        """Extra phase span, e.g. ``with monitor.span("Time/replay_ratio_wait"):``."""
+        return _tracer._SpanContext(name, self.tracer)
+
+    def metrics(self) -> Dict[str, float]:
+        """Span percentiles + memory/compile gauges, flattened for the logger."""
+        if not self.enabled:
+            return {}
+        out: Dict[str, float] = {}
+        if self.tracer is not None:
+            for name, stats in self.tracer.percentiles(reset=True).items():
+                for k, v in stats.items():
+                    out[f"{name}/{k}"] = v
+        out.update(self._telemetry_latest)
+        if self._watchdog is not None:
+            out.update(self._watchdog.metrics())
+        return out
+
+    def log_metrics(self, logger, metrics: Dict[str, float], step: int) -> None:
+        """Merge the monitor's metrics and forward to the logger inside a log span."""
+        if not self.enabled:
+            if logger is not None:
+                logger.log_metrics(metrics, step)
+            return
+        metrics.update(self.metrics())
+        if logger is None:
+            return
+        if self.tracer is not None:
+            self.tracer.begin(_LOG_SPAN)
+            try:
+                logger.log_metrics(metrics, step)
+            finally:
+                self.tracer.end(_LOG_SPAN)
+        else:
+            logger.log_metrics(metrics, step)
+
+    # ------------------------------------------------------------------ capture window
+    def _start_capture(self) -> None:
+        """Open an XProf profiler session writing to ``<log_dir>/xprof``.
+
+        Uses the low-level ``ProfilerSession`` (what ``jax.profiler.start_trace``
+        wraps) so the TSL *host* tracer level is controllable: at its default level
+        the host tracer installs thread hooks that SEGFAULT when certain third-party
+        threads are alive (observed with dm_control/glfw render threads + a
+        SummaryWriter event thread).  ``obs.host_tracer_level=0`` (the default) skips
+        host tracing entirely — device/XLA events, the part the span tracer cannot
+        see, are still captured — and is the only level safe everywhere."""
+        path = os.path.join(self.log_dir, "xprof")
+        try:
+            from jax._src.lib import xla_client
+
+            opts = xla_client.profiler.ProfileOptions()
+            opts.host_tracer_level = self._host_tracer_level
+            opts.python_tracer_level = 0
+            self._session = xla_client.profiler.ProfilerSession(opts)
+            self._capture_path = path
+            self._capturing = True
+        except Exception as e:  # no private API / profiler already active: don't kill training
+            self._session = None
+            warnings.warn(f"obs.capture_steps: could not start XProf trace at {path}: {e}")
+
+    def _stop_capture(self) -> None:
+        if self._session is not None:
+            try:
+                self._session.stop_and_export(self._capture_path)
+            except Exception as e:
+                warnings.warn(f"obs.capture_steps: could not export XProf trace: {e}")
+            self._session = None
+        self._capturing = False
+
+    # ------------------------------------------------------------------ teardown
+    def trace_path(self) -> str:
+        name = "trace.json" if self.rank == 0 else f"trace_rank{self.rank}.json"
+        return os.path.join(self.log_dir, name)
+
+    def close(self) -> None:
+        if not self.enabled or self._closed:
+            return
+        self._closed = True
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
+        if self._capturing:
+            self._stop_capture()
+        if self._watchdog is not None:
+            self._watchdog.close()
+        if self.tracer is not None:
+            self.tracer.end(_UPDATE_SPAN)
+            try:
+                self.tracer.export_chrome_trace(self.trace_path())
+            except OSError as e:
+                warnings.warn(f"could not export Chrome trace: {e}")
+            _tracer.set_active(self._prev_tracer)

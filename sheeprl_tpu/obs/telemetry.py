@@ -1,0 +1,80 @@
+"""Device/host memory telemetry → ``Memory/*`` metrics.
+
+Polls ``jax.local_devices()[i].memory_stats()`` (TPU/GPU HBM: ``bytes_in_use``,
+``peak_bytes_in_use``) on a wall-clock interval.  CPU backends return ``None`` from
+``memory_stats()``; the poller degrades to host RSS via ``resource.getrusage`` so a
+CPU run still gets a ``Memory/*`` signal instead of silence.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence
+
+# memory_stats() key → metric suffix; only these are logged (the full dict has ~15
+# allocator internals that would drown the dashboard).
+_DEVICE_KEYS = {
+    "bytes_in_use": "bytes_in_use",
+    "peak_bytes_in_use": "peak_bytes_in_use",
+    "bytes_limit": "bytes_limit",
+}
+
+
+def _host_rss_bytes() -> Dict[str, float]:
+    try:
+        import resource
+        import sys
+
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux, bytes on macOS.
+        scale = 1 if sys.platform == "darwin" else 1024
+        return {"Memory/host_peak_rss_bytes": float(usage.ru_maxrss) * scale}
+    except Exception:
+        return {}
+
+
+class DeviceTelemetry:
+    """Interval-gated poller; ``poll()`` returns ``{}`` between intervals so callers can
+    merge it into the metric flush unconditionally."""
+
+    def __init__(self, interval_s: float = 10.0, devices: Optional[Sequence[Any]] = None):
+        self.interval_s = float(interval_s)
+        self._devices = list(devices) if devices is not None else None
+        self._last_poll = float("-inf")
+        self.last: Dict[str, float] = {}
+
+    def devices(self) -> Sequence[Any]:
+        if self._devices is None:
+            import jax
+
+            self._devices = list(jax.local_devices())
+        return self._devices
+
+    def poll(self, force: bool = False) -> Dict[str, float]:
+        now = time.monotonic()
+        if not force and now - self._last_poll < self.interval_s:
+            return {}
+        self._last_poll = now
+        out: Dict[str, float] = {}
+        in_use_total = 0.0
+        peak_max = 0.0
+        saw_device_stats = False
+        for i, dev in enumerate(self.devices()):
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            saw_device_stats = True
+            for key, suffix in _DEVICE_KEYS.items():
+                if key in stats:
+                    out[f"Memory/{suffix}/dev{i}"] = float(stats[key])
+            in_use_total += float(stats.get("bytes_in_use", 0.0))
+            peak_max = max(peak_max, float(stats.get("peak_bytes_in_use", 0.0)))
+        if saw_device_stats:
+            out["Memory/bytes_in_use"] = in_use_total
+            out["Memory/peak_bytes_in_use"] = peak_max
+        out.update(_host_rss_bytes())
+        self.last = out
+        return out

@@ -1,0 +1,79 @@
+"""Recompilation watchdog → ``Compile/*`` metrics.
+
+A silently recompiling jitted train step is the single worst throughput bug on TPU: one
+leaked python scalar in a carry (or a shape that varies with episode length) turns a
+30µs cache hit into a multi-second XLA compile *every update*.  The watchdog counts
+backend compiles through ``jax.monitoring``'s ``backend_compile`` duration event,
+splits them at ``mark_warm()`` (end of the first update = expected warmup compiles),
+and flags every post-warmup compile as a recompile.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class RecompileWarning(UserWarning):
+    """Raised (via ``warnings.warn``) when a jitted function recompiles after warmup."""
+
+
+class RecompileWatchdog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._post_warmup = 0
+        self._unseen = 0  # post-warmup compiles not yet drained by poll_new()
+        self._warm = False
+        self._active = True
+
+        def _listener(event: str, duration_secs: float, **kwargs) -> None:
+            if not self._active or event != _BACKEND_COMPILE_EVENT:
+                return
+            with self._lock:
+                self._total += 1
+                if self._warm:
+                    self._post_warmup += 1
+                    self._unseen += 1
+
+        self._listener = _listener
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_listener)
+
+    def mark_warm(self) -> None:
+        """Everything compiled so far was warmup; anything after this is a recompile."""
+        with self._lock:
+            self._warm = True
+
+    @property
+    def total_compiles(self) -> int:
+        return self._total
+
+    @property
+    def recompiles(self) -> int:
+        return self._post_warmup
+
+    def poll_new(self) -> int:
+        """Post-warmup recompiles since the last poll (drains the unseen counter)."""
+        with self._lock:
+            n = self._unseen
+            self._unseen = 0
+        return n
+
+    def metrics(self) -> Dict[str, float]:
+        return {
+            "Compile/total_compiles": float(self._total),
+            "Compile/recompiles": float(self._post_warmup),
+        }
+
+    def close(self) -> None:
+        self._active = False
+        try:  # private in jax 0.4.x; the _active flag already neutralises the listener
+            from jax._src import monitoring as _m
+
+            _m._unregister_event_duration_listener_by_callback(self._listener)
+        except Exception:
+            pass

@@ -48,6 +48,8 @@ class TensorBoardLogger:
     def __init__(self, log_dir: str):
         self.log_dir = log_dir
         self._writer = None
+        self._jsonl = None
+        self._closed = False
         if jax.process_index() != 0:
             return
         try:
@@ -66,12 +68,12 @@ class TensorBoardLogger:
                 self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
 
     def log_metrics(self, metrics: Dict[str, float], step: int) -> None:
-        if jax.process_index() != 0:
+        if jax.process_index() != 0 or self._closed:
             return
         if self._writer is not None:
             for k, v in metrics.items():
                 self._writer.add_scalar(k, float(v), global_step=step)
-        else:
+        elif self._jsonl is not None:
             self._jsonl.write(json.dumps({"step": step, "time": time.time(), **metrics}) + "\n")
             self._jsonl.flush()
 
@@ -83,8 +85,12 @@ class TensorBoardLogger:
                 pass
 
     def close(self) -> None:
+        self._closed = True
         if self._writer is not None:
             self._writer.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
 
 
 class MlflowLogger:

@@ -21,9 +21,13 @@ class MeanMetric:
         self._count = 0
 
     def update(self, value: Any) -> None:
-        arr = np.asarray(value, dtype=np.float64)
-        self._sum += float(arr.sum())
-        self._count += int(arr.size)
+        # Drop non-finite values at update time: one NaN loss would otherwise poison
+        # the running sum for the whole log window (the reference only filters at
+        # compute time, after the damage is done).
+        arr = np.asarray(value, dtype=np.float64).reshape(-1)
+        finite = arr[np.isfinite(arr)]
+        self._sum += float(finite.sum())
+        self._count += int(finite.size)
 
     def compute(self) -> float:
         if self._count == 0:
@@ -53,7 +57,59 @@ class LastMetric(MeanMetric):
         return self._last
 
 
-_METRIC_TYPES = {"mean": MeanMetric, "sum": SumMetric, "last": LastMetric}
+class HistogramMetric:
+    """Latency-distribution accumulator for the span tracer's percentile export.
+
+    ``compute()`` returns a dict (``p50/p95/p99/mean/count``) instead of a float;
+    ``MetricAggregator.compute`` flattens it into ``<name>/<key>`` scalars so the
+    percentiles ride the existing logger pipeline unchanged.  Bounded by a ring
+    buffer: after ``max_samples`` values the oldest are overwritten, keeping the
+    window recent without unbounded growth over a long run.
+    """
+
+    KEYS = ("p50", "p95", "p99", "mean", "count")
+
+    def __init__(self, max_samples: int = 65536):
+        self._max = int(max_samples)
+        self._values: list = []
+        self._next = 0  # ring-buffer write head once the buffer is full
+        self._count = 0
+
+    def update(self, value: Any) -> None:
+        arr = np.asarray(value, dtype=np.float64).reshape(-1)
+        for v in arr[np.isfinite(arr)]:
+            if len(self._values) < self._max:
+                self._values.append(float(v))
+            else:
+                self._values[self._next] = float(v)
+                self._next = (self._next + 1) % self._max
+            self._count += 1
+
+    def compute(self) -> Optional[Dict[str, float]]:
+        if not self._values:
+            return None
+        vals = np.asarray(self._values)
+        p50, p95, p99 = np.percentile(vals, [50.0, 95.0, 99.0])
+        return {
+            "p50": float(p50),
+            "p95": float(p95),
+            "p99": float(p99),
+            "mean": float(vals.mean()),
+            "count": float(self._count),
+        }
+
+    def reset(self) -> None:
+        self._values = []
+        self._next = 0
+        self._count = 0
+
+
+_METRIC_TYPES = {
+    "mean": MeanMetric,
+    "sum": SumMetric,
+    "last": LastMetric,
+    "histogram": HistogramMetric,
+}
 
 
 class MetricAggregator:
@@ -103,7 +159,14 @@ class MetricAggregator:
             v = metric.compute()
             if v is None or (isinstance(v, float) and math.isnan(v)):
                 continue
-            out[name] = v
+            if isinstance(v, dict):
+                # Dict-valued metrics (HistogramMetric) flatten to <name>/<key> scalars.
+                for sub, sv in v.items():
+                    if isinstance(sv, float) and math.isnan(sv):
+                        continue
+                    out[f"{name}/{sub}"] = float(sv)
+            else:
+                out[name] = v
         return out
 
     def reset(self) -> None:
@@ -158,7 +221,14 @@ class RankIndependentMetricAggregator:
         from jax.experimental import multihost_utils
 
         # One fixed-order vector per rank keeps the gather shape static across ranks.
-        names = sorted(self._aggregator.metrics)
+        # Histogram metrics flatten to a deterministic key set, so expanding them here
+        # keeps every rank's vector aligned even when some ranks saw no samples.
+        names: list = []
+        for n in sorted(self._aggregator.metrics):
+            if isinstance(self._aggregator.metrics[n], HistogramMetric):
+                names.extend(f"{n}/{k}" for k in HistogramMetric.KEYS)
+            else:
+                names.append(n)
         vec = np.asarray([local.get(n, np.nan) for n in names], dtype=np.float64)
         gathered = np.asarray(multihost_utils.process_allgather(vec))  # [world, n_metrics]
         return {n: gathered[:, i] for i, n in enumerate(names)}

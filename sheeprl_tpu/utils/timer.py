@@ -2,12 +2,19 @@
 
 Class-level registry of named accumulating timers usable as context managers; drives the
 ``Time/sps_train`` / ``Time/sps_env_interaction`` throughput metrics.
+
+Every timed block is also a *span*: when a ``sheeprl_tpu.obs`` tracer is active, the
+``with timer(...)`` instrumentation already present in the algorithm loops feeds the
+hierarchical span tracer (Chrome-trace export + latency histograms) for free.  With no
+tracer active the hook is one global load + ``is None`` check.
 """
 
 from __future__ import annotations
 
 import time
 from typing import Dict
+
+from sheeprl_tpu.obs import tracer as _tracer
 
 
 class timer:
@@ -20,6 +27,7 @@ class timer:
 
     def __enter__(self):
         if not timer.disabled:
+            _tracer.maybe_begin(self.name)
             self._start = time.perf_counter()
         return self
 
@@ -27,6 +35,7 @@ class timer:
         if not timer.disabled:
             elapsed = time.perf_counter() - self._start
             timer._registry[self.name] = timer._registry.get(self.name, 0.0) + elapsed
+            _tracer.maybe_end(self.name)
         return False
 
     @classmethod

@@ -1,0 +1,50 @@
+"""Device telemetry: graceful on CPU (memory_stats() is None), full keys on fakes."""
+
+from sheeprl_tpu.obs.telemetry import DeviceTelemetry
+
+
+class _FakeDevice:
+    def __init__(self, in_use, peak, limit=1 << 30):
+        self._stats = {"bytes_in_use": in_use, "peak_bytes_in_use": peak, "bytes_limit": limit}
+
+    def memory_stats(self):
+        return self._stats
+
+
+class _StatlessDevice:
+    def memory_stats(self):
+        return None
+
+
+def test_cpu_backend_poll_is_graceful():
+    # Real CPU devices return None from memory_stats(): no Memory/*/devN keys, but the
+    # host-RSS fallback still gives a Memory/* signal.
+    t = DeviceTelemetry(interval_s=0.0)
+    out = t.poll(force=True)
+    assert not any(k.startswith("Memory/bytes_in_use/") for k in out)
+    assert out.get("Memory/host_peak_rss_bytes", 0) > 0
+
+
+def test_fake_device_stats_and_aggregates():
+    t = DeviceTelemetry(interval_s=0.0, devices=[_FakeDevice(100, 150), _FakeDevice(200, 300)])
+    out = t.poll(force=True)
+    assert out["Memory/bytes_in_use/dev0"] == 100.0
+    assert out["Memory/peak_bytes_in_use/dev1"] == 300.0
+    assert out["Memory/bytes_limit/dev0"] == float(1 << 30)
+    assert out["Memory/bytes_in_use"] == 300.0  # sum across devices
+    assert out["Memory/peak_bytes_in_use"] == 300.0  # max across devices
+
+
+def test_mixed_devices_skip_statless():
+    t = DeviceTelemetry(interval_s=0.0, devices=[_StatlessDevice(), _FakeDevice(50, 60)])
+    out = t.poll(force=True)
+    assert "Memory/bytes_in_use/dev0" not in out
+    assert out["Memory/bytes_in_use/dev1"] == 50.0
+
+
+def test_interval_gating():
+    t = DeviceTelemetry(interval_s=3600.0, devices=[_FakeDevice(1, 2)])
+    assert t.poll()  # first poll always fires (last_poll = -inf)
+    assert t.poll() == {}  # gated
+    assert t.poll(force=True)  # force bypasses the gate
+    assert t.last["Memory/bytes_in_use/dev0"] == 1.0

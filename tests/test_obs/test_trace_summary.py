@@ -1,0 +1,55 @@
+"""benchmarks/trace_summary.py folds a tracer export into a per-phase time table."""
+
+import importlib.util
+import pathlib
+import time
+
+import pytest
+
+from sheeprl_tpu.obs import tracer as tr
+from sheeprl_tpu.obs.tracer import SpanTracer, span
+
+_SPEC = importlib.util.spec_from_file_location(
+    "trace_summary", pathlib.Path(__file__).resolve().parents[2] / "benchmarks" / "trace_summary.py"
+)
+trace_summary = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(trace_summary)
+
+
+@pytest.fixture()
+def trace_file(tmp_path):
+    t = SpanTracer(rank=0)
+    prev = tr.set_active(t)
+    try:
+        for _ in range(3):
+            with span("Time/update"):
+                with span("Time/train_time"):
+                    time.sleep(0.001)
+                with span("Time/env_interaction_time"):
+                    pass
+    finally:
+        tr.set_active(prev)
+    path = tmp_path / "trace.json"
+    t.export_chrome_trace(str(path))
+    return path
+
+
+def test_summarize_per_phase(trace_file):
+    summary = trace_summary.summarize(str(trace_file))
+    phases = summary["phases"]
+    assert set(phases) == {"Time/update", "Time/train_time", "Time/env_interaction_time"}
+    assert phases["Time/train_time"]["count"] == 3
+    # updates are the only depth-0 spans: their total IS the top-level wall clock
+    assert summary["top_level_total_ms"] == pytest.approx(phases["Time/update"]["total_ms"])
+    assert phases["Time/update"]["share"] == pytest.approx(1.0)
+    # nested phases can't exceed their parent's share
+    assert phases["Time/train_time"]["share"] < 1.0
+    assert phases["Time/train_time"]["p50_ms"] <= phases["Time/train_time"]["p99_ms"]
+
+
+def test_format_table_and_cli(trace_file, capsys):
+    assert trace_summary.main([str(trace_file)]) == 0
+    out = capsys.readouterr().out
+    assert "Time/train_time" in out and "share" in out and "top-level wall clock" in out
+    assert trace_summary.main([str(trace_file), "--json"]) == 0
+    assert '"phases"' in capsys.readouterr().out

@@ -1,0 +1,51 @@
+"""Recompile watchdog: warmup compiles are free, post-warmup cache misses are counted."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.obs.watchdog import RecompileWatchdog
+
+
+@pytest.fixture()
+def watchdog():
+    w = RecompileWatchdog()
+    yield w
+    w.close()
+
+
+def test_counts_exactly_one_miss_after_warmup(watchdog):
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    # Pre-stage inputs so the only compile the new shape triggers is f's own.
+    x3 = jax.device_put(np.ones(3, dtype=np.float32))
+    x5 = jax.device_put(np.ones(5, dtype=np.float32))
+    jax.block_until_ready(f(x3))  # warmup compile
+    watchdog.mark_warm()
+    assert watchdog.recompiles == 0
+
+    jax.block_until_ready(f(x3))  # cache hit
+    assert watchdog.recompiles == 0
+    assert watchdog.poll_new() == 0
+
+    jax.block_until_ready(f(x5))  # new shape -> exactly one cache miss
+    assert watchdog.recompiles == 1
+    assert watchdog.poll_new() == 1
+    assert watchdog.poll_new() == 0  # drained
+    assert watchdog.metrics()["Compile/recompiles"] == 1.0
+    assert watchdog.metrics()["Compile/total_compiles"] >= 2.0
+
+
+def test_closed_watchdog_stops_counting(watchdog):
+    watchdog.mark_warm()
+    watchdog.close()
+
+    @jax.jit
+    def g(x):
+        return jnp.sin(x)
+
+    jax.block_until_ready(g(jax.device_put(np.ones(7, dtype=np.float32))))
+    assert watchdog.recompiles == 0

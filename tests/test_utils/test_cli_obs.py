@@ -1,0 +1,59 @@
+"""CLI-level observability glue: the JAX_PLATFORMS late-init warning and obs config
+validation."""
+
+import warnings
+
+import jax
+import pytest
+
+from sheeprl_tpu.cli import _honor_platform_env, check_configs
+from sheeprl_tpu.config.core import compose
+
+
+def test_honor_platform_env_warns_on_backend_mismatch(monkeypatch):
+    jax.devices()  # force backend initialisation (idempotent under the test suite)
+    prev = jax.config.jax_platforms
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")  # request != the live cpu backend
+    try:
+        with pytest.warns(UserWarning, match="already initialized"):
+            _honor_platform_env()
+    finally:
+        jax.config.update("jax_platforms", prev)
+
+
+def test_honor_platform_env_silent_when_request_already_satisfied(monkeypatch):
+    jax.devices()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")  # the live backend IS cpu: no warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _honor_platform_env()
+
+
+def test_honor_platform_env_silent_when_unset(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _honor_platform_env()
+
+
+def _ppo_cfg(*overrides):
+    return compose(overrides=["exp=ppo_dummy", *overrides])
+
+
+def test_check_configs_accepts_valid_capture_window():
+    check_configs(_ppo_cfg("obs.capture_steps=[2,5]"))
+    check_configs(_ppo_cfg())  # null window
+
+
+@pytest.mark.parametrize("window", ["[5,2]", "[0,3]", "[3]"])
+def test_check_configs_rejects_bad_capture_window(window):
+    with pytest.raises(ValueError, match="capture_steps"):
+        check_configs(_ppo_cfg(f"obs.capture_steps={window}"))
+
+
+def test_obs_config_group_defaults():
+    cfg = _ppo_cfg()
+    assert cfg.obs.enabled is False
+    assert cfg.obs.trace is True
+    assert cfg.obs.capture_steps is None
+    assert cfg.obs.warmup_updates == 1

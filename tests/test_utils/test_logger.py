@@ -71,3 +71,29 @@ def test_default_logger_is_tensorboard(tmp_path):
 def test_log_level_zero_disables_logger(tmp_path):
     cfg = compose(overrides=["exp=ppo_dummy", "metric.log_level=0"])
     assert get_logger(cfg, str(tmp_path)) is None
+
+
+def _jsonl_logger(tmp_path, monkeypatch):
+    """Force the JSONL fallback by making both SummaryWriter imports fail."""
+    monkeypatch.setitem(sys.modules, "tensorboardX", None)
+    monkeypatch.setitem(sys.modules, "torch.utils.tensorboard", None)
+    return TensorBoardLogger(str(tmp_path))
+
+
+def test_jsonl_fallback_close_releases_handle(tmp_path, monkeypatch):
+    logger = _jsonl_logger(tmp_path, monkeypatch)
+    assert logger._writer is None and logger._jsonl is not None
+    logger.log_metrics({"a": 1.0}, step=1)
+    handle = logger._jsonl
+    logger.close()
+    assert logger._jsonl is None and handle.closed  # the fd used to leak
+
+
+def test_log_metrics_after_close_is_noop(tmp_path, monkeypatch):
+    logger = _jsonl_logger(tmp_path, monkeypatch)
+    logger.log_metrics({"a": 1.0}, step=1)
+    logger.close()
+    logger.log_metrics({"b": 2.0}, step=2)  # must not raise on the closed handle
+    lines = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 1
+    logger.close()  # idempotent

@@ -1,0 +1,85 @@
+"""Metric accumulators: NaN hygiene at update time, histogram percentiles, and the
+aggregator's dict-flattening for histogram exports."""
+
+import math
+
+import numpy as np
+
+from sheeprl_tpu.utils.metric import (
+    HistogramMetric,
+    MeanMetric,
+    MetricAggregator,
+    SumMetric,
+)
+
+
+def test_mean_metric_drops_nonfinite_at_update():
+    m = MeanMetric()
+    m.update(1.0)
+    m.update(float("nan"))
+    m.update(float("inf"))
+    m.update(3.0)
+    assert m.compute() == 2.0  # nan/inf never reached the running sum
+
+
+def test_mean_metric_array_update_filters_elementwise():
+    m = MeanMetric()
+    m.update(np.array([1.0, np.nan, 5.0]))
+    assert m.compute() == 3.0
+
+
+def test_sum_metric_nan_guard():
+    m = SumMetric()
+    m.update([2.0, float("nan"), 4.0])
+    assert m.compute() == 6.0
+
+
+def test_histogram_metric_percentiles():
+    h = HistogramMetric()
+    h.update(list(range(1, 101)))  # 1..100
+    out = h.compute()
+    assert out["count"] == 100
+    assert abs(out["p50"] - 50.5) < 1.0
+    assert abs(out["p95"] - 95.05) < 1.0
+    assert abs(out["p99"] - 99.01) < 1.0
+    assert out["mean"] == 50.5
+    h.reset()
+    assert h.compute() is None
+
+
+def test_histogram_metric_drops_nonfinite_and_caps():
+    h = HistogramMetric(max_samples=4)
+    h.update([1.0, float("nan")])
+    for v in (2.0, 3.0, 4.0, 5.0, 6.0):
+        h.update(v)
+    out = h.compute()
+    assert out["count"] == 6  # total observations, including overwritten ones
+    # ring buffer keeps the 4 most recent values
+    assert out["p99"] <= 6.0 and out["p50"] >= 3.0
+
+
+def test_aggregator_flattens_histograms():
+    agg = MetricAggregator({"Time/step": "histogram", "Loss/x": "mean"})
+    for v in (1.0, 2.0, 3.0):
+        agg.update("Time/step", v)
+    agg.update("Loss/x", 0.5)
+    out = agg.compute()
+    assert out["Loss/x"] == 0.5
+    assert out["Time/step/p50"] == 2.0
+    assert out["Time/step/count"] == 3.0
+    assert "Time/step" not in out  # the dict-valued metric only appears flattened
+
+
+def test_aggregator_skips_empty_histogram():
+    agg = MetricAggregator({"Time/idle": "histogram"})
+    assert agg.compute() == {}
+
+
+def test_nan_update_no_longer_poisons_window():
+    # Regression: one NaN loss used to wipe the whole log window's mean.
+    agg = MetricAggregator({"Loss/value_loss": "mean"})
+    agg.update("Loss/value_loss", 1.0)
+    agg.update("Loss/value_loss", float("nan"))
+    out = agg.compute()
+    assert out["Loss/value_loss"] == 1.0
+    assert not math.isnan(out["Loss/value_loss"])
