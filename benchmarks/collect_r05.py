@@ -47,6 +47,27 @@ def read_run(version_dir: str) -> dict:
     }
 
 
+def flag_incomplete(run: dict, fraction: float = 0.9) -> dict:
+    """Mark a run whose logged curve stops well short of its configured total
+    steps: ``"incomplete": true`` plus an explanatory note suffix.  Complete runs
+    always flush a final metric window at ~total_steps, so a last curve step
+    below ``fraction * policy_steps`` means the run died/was killed early and its
+    numbers must not be cited as final."""
+    curve = run.get("train_reward_curve") or []
+    total = int(run.get("policy_steps") or 0)
+    last_step = int(curve[-1][0]) if curve else 0
+    if total > 0 and last_step < fraction * total:
+        run["incomplete"] = True
+        suffix = (
+            f". RUN INCOMPLETE: logged curve stops at policy step {last_step} of {total}"
+            f"{' and there is no final test reward' if run.get('final_test_reward') is None else ''}"
+            " — rerun before citing"
+        )
+        if suffix.strip(". ") not in (run.get("notes") or ""):
+            run["notes"] = (run.get("notes") or "").rstrip(". ") + suffix
+    return run
+
+
 def latest_version(pattern: str):
     def version_num(path: str) -> int:
         tail = path.rstrip("/").rsplit("_", 1)[-1]
@@ -113,11 +134,25 @@ def main() -> None:
                 run["label"] = name
                 run["command"] = commands.get(name, "")
                 run["notes"] = notes.get(name, "")
-                additional.append(run)
+                additional.append(flag_incomplete(run))
             except Exception as exc:
                 print(f"skip {name}: {exc}", file=sys.stderr)
 
-    out = {"walker_multiseed": walker, "additional_runs": additional}
+    # Merge-preserving write: labels this script did not (re)produce — e.g. the
+    # r5b runs merged by collect_r05b.py, or runs whose log dirs were cleaned —
+    # are kept from the existing file instead of being silently dropped.
+    out = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                out = json.load(f)
+        except Exception as exc:
+            print(f"ignoring unreadable {out_path}: {exc}", file=sys.stderr)
+            out = {}
+    produced = {r["label"] for r in additional}
+    preserved = [r for r in out.get("additional_runs", []) if r.get("label") not in produced]
+    out["walker_multiseed"] = walker
+    out["additional_runs"] = preserved + additional
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
     slim = {

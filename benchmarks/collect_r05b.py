@@ -18,7 +18,7 @@ import json
 import os
 import sys
 
-from collect_r05 import latest_version, read_run  # noqa: E402
+from collect_r05 import flag_incomplete, latest_version, read_run  # noqa: E402
 
 COMMANDS = {
     "a2c_cartpole_r5": (
@@ -60,7 +60,7 @@ NOTES = {
     ),
     "sac_ae_cartpole_r5": (
         "SAC-AE from pixels on cartpole_swingup (paper hyperparams: action_repeat 8, "
-        "deterministic AE regulariser), 500K env frames"
+        "deterministic AE regulariser), configured for 500K env frames"
     ),
 }
 
@@ -86,6 +86,11 @@ def main() -> None:
         run["label"] = name
         run["command"] = COMMANDS[name]
         run["notes"] = NOTES[name]
+        # Truncated runs (curve stops short of the configured total steps) are
+        # merged with "incomplete": true so their numbers are never cited as final
+        # (the first sac_ae_cartpole_r5 merge shipped a 2000-of-62500-step run
+        # unlabeled — advisor finding r5).
+        flag_incomplete(run)
         additional[:] = [r for r in additional if r.get("label") != name]
         additional.append(run)
 

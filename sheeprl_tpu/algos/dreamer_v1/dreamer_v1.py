@@ -46,6 +46,7 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialRepla
 from sheeprl_tpu.data.device_buffer import make_device_replay
 from sheeprl_tpu.distributions import BernoulliSafeMode, Independent, Normal
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.rollout import rollout_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -434,6 +435,7 @@ def main(ctx, cfg) -> None:
                 cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
             )
             metrics["Params/exploration_amount"] = expl_amount
+            metrics.update(rollout_metrics(envs))
             monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step

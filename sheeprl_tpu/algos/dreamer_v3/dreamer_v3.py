@@ -57,6 +57,7 @@ from sheeprl_tpu.distributions import (
     TwoHotEncodingDistribution,
 )
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.rollout import PipelinedPlayer, rollout_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, make_aggregator, record_episode_stats
@@ -467,6 +468,36 @@ def main(ctx, cfg) -> None:
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
     player_state = player_state_init(num_envs)
+
+    # Acting pipeline (sheeprl_tpu/rollout): depth 0 is the historical synchronous
+    # dispatch -> one device_get -> env.step path, bit-for-bit; depth>=1 overlaps
+    # the policy jit and the action fetch with the workers' env step (policy lag).
+    def _pipeline_policy(cur_obs):
+        nonlocal player_state
+        obs_t = prepare_obs(cur_obs, cnn_keys, mlp_keys, num_envs)
+        actions, stored, player_state = player_jit(
+            params, player_state, obs_t, jnp.asarray(is_first_np), ctx.local_rng()
+        )
+        return (stored, list(actions))
+
+    def _pipeline_post(fetched):
+        # ONE device_get for everything the host needs (per-array fetches would
+        # each pay a transfer round trip on a remote accelerator).
+        stored_np, acts_list = fetched
+        stored_actions = np.asarray(stored_np)
+        acts_np = [np.asarray(a) for a in acts_list]
+        if is_continuous:
+            env_actions = acts_np[0]
+        elif len(actions_dim) == 1:
+            env_actions = acts_np[0].argmax(-1)
+        else:
+            env_actions = np.stack([a.argmax(-1) for a in acts_np], -1)
+        return env_actions, stored_actions
+
+    rollout_player = PipelinedPlayer(
+        envs, _pipeline_policy, _pipeline_post, depth=int((cfg.get("rollout") or {}).get("pipeline_depth", 0))
+    )
+
     step_data: Dict[str, np.ndarray] = _obs_row(obs)
     step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
@@ -498,21 +529,7 @@ def main(ctx, cfg) -> None:
                     # keep the player state in sync with the executed action
                     player_state = player_state._replace(actions=jnp.asarray(stored_actions))
                 else:
-                    obs_t = prepare_obs(obs, cnn_keys, mlp_keys, num_envs)
-                    actions, stored, player_state = player_jit(
-                        params, player_state, obs_t, jnp.asarray(is_first_np), ctx.local_rng()
-                    )
-                    # ONE device_get for everything the host needs (per-array fetches
-                    # would each pay a transfer round trip on a remote accelerator).
-                    stored_np, acts_list = jax.device_get((stored, list(actions)))
-                    stored_actions = np.asarray(stored_np)
-                    acts_np = [np.asarray(a) for a in acts_list]
-                    if is_continuous:
-                        env_actions = acts_np[0]
-                    elif len(actions_dim) == 1:
-                        env_actions = acts_np[0].argmax(-1)
-                    else:
-                        env_actions = np.stack([a.argmax(-1) for a in acts_np], -1)
+                    env_actions, stored_actions = rollout_player.act(obs)
 
                 # Commit the pending row with the action taken from its observation
                 # (under the prefetcher's lock: the sampler thread must not read rows
@@ -544,7 +561,7 @@ def main(ctx, cfg) -> None:
 
             env_t0 = time.perf_counter()
             with timer("Time/env_interaction_time"), timer("Time/phase_env_step"):
-                next_obs, reward, terminated, truncated, info = envs.step(env_actions)
+                next_obs, reward, terminated, truncated, info = rollout_player.env_step(env_actions)
                 if cfg.env.clip_rewards:
                     reward = np.clip(reward, -1, 1)
                 done = np.logical_or(terminated, truncated)
@@ -635,6 +652,7 @@ def main(ctx, cfg) -> None:
                 metrics["Params/replay_ratio"] = (
                     cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
                 )
+                metrics.update(rollout_metrics(envs))
                 monitor.log_metrics(logger, metrics, policy_step)
                 aggregator.reset()
                 last_log = policy_step

@@ -39,6 +39,7 @@ from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.rollout import PipelinedPlayer, rollout_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -238,23 +239,37 @@ def main(ctx, cfg) -> None:
     step_data: Dict[str, np.ndarray] = {}
     start_time = time.perf_counter()
 
+    # Acting pipeline (sheeprl_tpu/rollout).  depth 0 reproduces the historical
+    # synchronous path exactly; depth>=1 overlaps the policy jit with the env
+    # workers at the cost of a policy lag — note PPO's loss then trains on
+    # slightly stale log-probs/values (see howto/async_rollout.md).
+    def _pipeline_policy(cur_obs):
+        obs_t = prepare_obs(cur_obs, cnn_keys, mlp_keys)
+        return act_fn(params, obs_t, ctx.local_rng())
+
+    def _pipeline_post(fetched):
+        env_act_np, _, logprob_np, value_np = (np.asarray(x) for x in fetched)
+        if is_continuous:
+            low, high = act_space.low, act_space.high
+            env_actions = np.clip(env_act_np, low, high) if np.isfinite(low).all() else env_act_np
+        elif len(agent.action_dims) == 1:
+            env_actions = env_act_np[..., 0]
+        else:
+            env_actions = env_act_np
+        return env_actions, (env_act_np, logprob_np, value_np)
+
+    rollout_player = PipelinedPlayer(
+        envs, _pipeline_policy, _pipeline_post, depth=int((cfg.get("rollout") or {}).get("pipeline_depth", 0))
+    )
+
     for update in range(start_update, num_updates + 1):
         monitor.advance()
         train_time = 0.0
         env_time_start = time.perf_counter()
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
-                obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
-                env_act, stored_act, logprob, value = act_fn(params, obs_t, ctx.local_rng())
-                env_act_np = np.asarray(jax.device_get(env_act))
-                if is_continuous:
-                    low, high = act_space.low, act_space.high
-                    env_actions = np.clip(env_act_np, low, high) if np.isfinite(low).all() else env_act_np
-                elif len(agent.action_dims) == 1:
-                    env_actions = env_act_np[..., 0]
-                else:
-                    env_actions = env_act_np
-                next_obs, reward, terminated, truncated, info = envs.step(env_actions)
+                env_actions, (env_act_np, logprob_np, value_np) = rollout_player.act(obs)
+                next_obs, reward, terminated, truncated, info = rollout_player.env_step(env_actions)
                 if cfg.env.clip_rewards:
                     reward = np.clip(reward, -1, 1)
                 done = np.logical_or(terminated, truncated)
@@ -276,8 +291,8 @@ def main(ctx, cfg) -> None:
                 for k in obs_keys:
                     step_data[k] = np.asarray(obs[k])[None]
                 step_data["actions"] = env_act_np.reshape(num_envs, -1).astype(np.float32)[None]
-                step_data["logprobs"] = np.asarray(jax.device_get(logprob)).reshape(num_envs, 1)[None]
-                step_data["values"] = np.asarray(jax.device_get(value)).reshape(num_envs, 1)[None]
+                step_data["logprobs"] = logprob_np.reshape(num_envs, 1)[None]
+                step_data["values"] = value_np.reshape(num_envs, 1)[None]
                 step_data["rewards"] = reward.reshape(num_envs, 1)[None]
                 step_data["dones"] = done.astype(np.float32).reshape(num_envs, 1)[None]
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
@@ -329,6 +344,7 @@ def main(ctx, cfg) -> None:
             metrics["Params/lr"] = (
                 float(lr_schedule(grad_step_count)) if lr_schedule is not None else float(cfg.algo.optimizer.lr)
             )
+            metrics.update(rollout_metrics(envs))
             monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step

@@ -29,6 +29,7 @@ from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.rollout import PipelinedPlayer, rollout_metrics
 from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -216,6 +217,22 @@ def main(ctx, cfg) -> None:
     obs, _ = envs.reset(seed=cfg.seed + rank)
     step_data: Dict[str, np.ndarray] = {}
 
+    # Acting pipeline (sheeprl_tpu/rollout): depth 0 is the historical synchronous
+    # path bit-for-bit; depth>=1 overlaps the actor jit + action fetch with the env
+    # workers (policy lag — benign for SAC's replay-based update).
+    def _pipeline_policy(cur_obs):
+        obs_t = prepare_obs(cur_obs, mlp_keys)
+        return act_fn(params["actor"], obs_t, ctx.local_rng())
+
+    def _pipeline_post(fetched):
+        tanh_np = np.asarray(fetched)
+        env_acts = act_low + (tanh_np + 1) * 0.5 * (act_high - act_low) if rescale else tanh_np
+        return env_acts, tanh_np
+
+    rollout_player = PipelinedPlayer(
+        envs, _pipeline_policy, _pipeline_post, depth=int((cfg.get("rollout") or {}).get("pipeline_depth", 0))
+    )
+
     # Async host-side sampling (SURVEY §7): the worker draws + ships the next [G, B]
     # block while the device executes the current one; ``rb.add`` holds the sampler's
     # lock so the worker never reads a row mid-write.  ``next_{k}`` keys are stored
@@ -267,11 +284,7 @@ def main(ctx, cfg) -> None:
                     2 * (actions - act_low) / (act_high - act_low) - 1 if rescale else actions
                 )
             else:
-                obs_t = prepare_obs(obs, mlp_keys)
-                tanh_actions = np.asarray(jax.device_get(act_fn(params["actor"], obs_t, ctx.local_rng())))
-                actions = (
-                    act_low + (tanh_actions + 1) * 0.5 * (act_high - act_low) if rescale else tanh_actions
-                )
+                actions, tanh_actions = rollout_player.act(obs)
         env_time = time.perf_counter() - env_t0
 
         # Dispatch this iteration's gradient block BEFORE stepping the envs so the
@@ -295,7 +308,7 @@ def main(ctx, cfg) -> None:
 
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
-            next_obs, reward, terminated, truncated, info = envs.step(actions)
+            next_obs, reward, terminated, truncated, info = rollout_player.env_step(actions)
             done = np.logical_or(terminated, truncated)
 
             # Store the TRUE next observation for done envs (SAME_STEP autoreset
@@ -336,6 +349,7 @@ def main(ctx, cfg) -> None:
             metrics["Params/replay_ratio"] = (
                 cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
             )
+            metrics.update(rollout_metrics(envs))
             monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step

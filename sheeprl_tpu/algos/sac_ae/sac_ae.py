@@ -30,6 +30,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import DeviceReplayMirror, device_replay_enabled
 from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.rollout import rollout_metrics
 from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -442,6 +443,7 @@ def main(ctx, cfg) -> None:
                 metrics["Time/sps_train"] = window_sps
             metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
             metrics["Params/replay_ratio"] = cumulative_grad_steps * world / policy_step if policy_step else 0.0
+            metrics.update(rollout_metrics(envs))
             monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
             last_log = policy_step

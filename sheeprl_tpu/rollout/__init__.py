@@ -1,0 +1,24 @@
+"""Asynchronous, fault-tolerant environment execution engine.
+
+* ``EnvPool`` — shared-memory multi-process vector env, drop-in for the
+  ``SyncVectorEnv(SAME_STEP)`` path behind ``cfg.env.pool.enabled``;
+* ``PipelinedPlayer`` — overlaps policy dispatch, the action ``device_get`` and
+  env stepping (``cfg.rollout.pipeline_depth``);
+* ``rollout_metrics`` — ``Rollout/*`` counters for the metric flush;
+* ``RolloutAbortError`` — raised when the worker-restart budget is exhausted.
+
+``EnvPool`` itself never imports JAX (its workers must stay device-free);
+``PipelinedPlayer`` does, so it is re-exported lazily via ``__getattr__``.
+"""
+
+from sheeprl_tpu.rollout.pool import EnvPool, RolloutAbortError, rollout_metrics
+
+__all__ = ["EnvPool", "PipelinedPlayer", "RolloutAbortError", "rollout_metrics"]
+
+
+def __getattr__(name: str):
+    if name == "PipelinedPlayer":
+        from sheeprl_tpu.rollout.pipeline import PipelinedPlayer
+
+        return PipelinedPlayer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,129 @@
+"""Preallocated shared-memory slabs for the rollout engine.
+
+One flat ``multiprocessing.RawArray`` per batched leaf (obs key / rewards /
+terminated / truncated / actions / heartbeats), allocated once by the parent and
+inherited by the worker processes at fork time.  Per step the workers write their
+env-group slice in place and the parent reads it back — no per-step pickling of
+observations or rewards crosses the pipe (only episode-boundary payloads do, and
+those are rare by construction).
+
+Layouts mirror gymnasium's vector conventions exactly
+(``create_empty_array(single_space, n)``), so a slab view is bit-compatible with
+what ``SyncVectorEnv`` would have concatenated: ``Dict`` spaces become a dict of
+``[num_envs, *leaf_shape]`` arrays, flat spaces a single batched array, rewards
+are float64 and the done flags are bools.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from typing import Any, Dict, Tuple, Union
+
+import gymnasium as gym
+import numpy as np
+from gymnasium.vector.utils import create_empty_array
+
+SlabView = Union[np.ndarray, Dict[str, np.ndarray]]
+
+
+def _alloc_raw(shape: Tuple[int, ...], dtype: np.dtype):
+    """RawArray (no lock: each worker owns a disjoint slice) sized for shape/dtype."""
+    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return mp.RawArray("b", max(nbytes, 1))
+
+
+def _view(raw, shape: Tuple[int, ...], dtype: np.dtype) -> np.ndarray:
+    return np.frombuffer(raw, dtype=dtype, count=int(np.prod(shape, dtype=np.int64))).reshape(shape)
+
+
+class SharedSlab:
+    """A single space's batched shared buffer: raw storage + numpy view factory."""
+
+    def __init__(self, space: gym.Space, num_envs: int):
+        template = create_empty_array(space, n=num_envs, fn=np.zeros)
+        if isinstance(template, dict):
+            self._spec = {k: (v.shape, v.dtype) for k, v in template.items()}
+            self._raw = {k: _alloc_raw(v.shape, v.dtype) for k, v in template.items()}
+        elif isinstance(template, np.ndarray):
+            self._spec = (template.shape, template.dtype)
+            self._raw = _alloc_raw(template.shape, template.dtype)
+        else:
+            raise TypeError(
+                f"EnvPool supports Dict[str, Box]/Box/Discrete/MultiDiscrete/MultiBinary "
+                f"spaces; got a batched template of type {type(template).__name__} for {space}"
+            )
+
+    def view(self) -> SlabView:
+        """Rebuild the numpy view; call from each process (views don't cross fork)."""
+        if isinstance(self._spec, dict):
+            return {k: _view(self._raw[k], *self._spec[k]) for k in self._spec}
+        return _view(self._raw, *self._spec)
+
+
+class RolloutSlabs:
+    """The full per-pool slab set.  Constructed in the parent before workers fork;
+    each process calls ``views()`` to get its own numpy windows over the same pages."""
+
+    def __init__(self, observation_space: gym.Space, action_space: gym.Space, num_envs: int, num_workers: int):
+        self.obs = SharedSlab(observation_space, num_envs)
+        self.actions = SharedSlab(action_space, num_envs)
+        self._rewards = _alloc_raw((num_envs,), np.float64)
+        self._terminated = _alloc_raw((num_envs,), np.bool_)
+        self._truncated = _alloc_raw((num_envs,), np.bool_)
+        self._heartbeats = _alloc_raw((num_workers,), np.float64)
+        self._num_envs = num_envs
+        self._num_workers = num_workers
+
+    def views(self) -> "SlabViews":
+        return SlabViews(
+            obs=self.obs.view(),
+            actions=self.actions.view(),
+            rewards=_view(self._rewards, (self._num_envs,), np.float64),
+            terminated=_view(self._terminated, (self._num_envs,), np.bool_),
+            truncated=_view(self._truncated, (self._num_envs,), np.bool_),
+            heartbeats=_view(self._heartbeats, (self._num_workers,), np.float64),
+        )
+
+
+class SlabViews:
+    __slots__ = ("obs", "actions", "rewards", "terminated", "truncated", "heartbeats")
+
+    def __init__(self, obs, actions, rewards, terminated, truncated, heartbeats):
+        self.obs = obs
+        self.actions = actions
+        self.rewards = rewards
+        self.terminated = terminated
+        self.truncated = truncated
+        self.heartbeats = heartbeats
+
+    # ------------------------------------------------------------------ helpers
+    def write_obs(self, env_idx: int, obs: Any) -> None:
+        if isinstance(self.obs, dict):
+            for k, slab in self.obs.items():
+                slab[env_idx] = np.asarray(obs[k])
+        else:
+            self.obs[env_idx] = np.asarray(obs)
+
+    def read_obs_batch(self) -> SlabView:
+        """A snapshot copy of the batched observation (callers keep obs across steps)."""
+        if isinstance(self.obs, dict):
+            return {k: v.copy() for k, v in self.obs.items()}
+        return self.obs.copy()
+
+    def read_action(self, env_idx: int) -> Any:
+        act = self.actions[env_idx] if not isinstance(self.actions, dict) else {
+            k: v[env_idx] for k, v in self.actions.items()
+        }
+        # Row views alias the slab; hand the env its own copy.
+        if isinstance(act, np.ndarray):
+            return np.array(act)
+        if isinstance(act, dict):
+            return {k: np.array(v) for k, v in act.items()}
+        return act
+
+    def write_actions(self, actions: Any) -> None:
+        if isinstance(self.actions, dict):
+            for k, slab in self.actions.items():
+                slab[:] = np.asarray(actions[k])
+        else:
+            self.actions[:] = np.asarray(actions).reshape(self.actions.shape)

@@ -1,0 +1,118 @@
+"""Worker-process loop for ``EnvPool``.
+
+Each worker owns one contiguous group of environments.  The protocol over its
+duplex pipe is command/ack:
+
+    ("reset", seeds, options) -> ("ok", [(env_idx, [info, ...]), ...])
+    ("step",)                 -> ("ok", [(env_idx, [info, ...]), ...])
+    ("close",)                -> ("ok", None)
+
+Observations, rewards and done flags never ride the pipe: the worker writes them
+into its slice of the shared slabs (``shared.py``) and the ack only carries the
+*info* payloads — empty for an ordinary step, the ``{"final_obs", "final_info"}``
+pair plus the reset info on an episode boundary, exactly the dicts
+``SyncVectorEnv`` would feed ``_add_info`` in ``SAME_STEP`` autoreset mode, in
+the same per-env order.
+
+This module must stay importable without JAX: it runs in forked children that
+never touch a device.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+InfoPayload = List[Tuple[int, List[dict]]]
+
+
+def _start_heartbeat(heartbeats, worker_idx: int, interval_s: float) -> None:
+    """Daemon thread stamping wall-clock time: a stale stamp means the *process*
+    died (crash/OOM/kill) — a hung env keeps beating and is caught by the parent's
+    step timeout instead."""
+
+    def beat() -> None:
+        while True:
+            heartbeats[worker_idx] = time.time()
+            time.sleep(interval_s)
+
+    threading.Thread(target=beat, name=f"envpool-heartbeat-{worker_idx}", daemon=True).start()
+
+
+def worker_entry(
+    worker_idx: int,
+    first_env_idx: int,
+    env_fns: Sequence[Callable[[], Any]],
+    slabs,
+    conn,
+    heartbeat_interval_s: float,
+) -> None:
+    envs: List[Any] = []
+    try:
+        views = slabs.views()
+        _start_heartbeat(views.heartbeats, worker_idx, max(heartbeat_interval_s, 0.05))
+        envs = [fn() for fn in env_fns]
+        conn.send(("ready", None))
+        while True:
+            msg = conn.recv()
+            cmd = msg[0]
+            if cmd == "reset":
+                _, seeds, options = msg
+                payloads: InfoPayload = []
+                for j, env in enumerate(envs):
+                    gi = first_env_idx + j
+                    obs, info = env.reset(seed=seeds[j], options=options)
+                    views.write_obs(gi, obs)
+                    views.rewards[gi] = 0.0
+                    views.terminated[gi] = False
+                    views.truncated[gi] = False
+                    payloads.append((gi, [info] if info else []))
+                conn.send(("ok", payloads))
+            elif cmd == "step":
+                payloads = []
+                for j, env in enumerate(envs):
+                    gi = first_env_idx + j
+                    action = views.read_action(gi)
+                    obs, reward, terminated, truncated, info = env.step(action)
+                    entries: List[dict] = []
+                    if terminated or truncated:
+                        # SAME_STEP autoreset: surface the pre-reset obs/info, then
+                        # reset immediately (gymnasium SyncVectorEnv.step parity).
+                        entries.append({"final_obs": obs, "final_info": info})
+                        obs, info = env.reset()
+                    if info:
+                        entries.append(info)
+                    views.write_obs(gi, obs)
+                    views.rewards[gi] = reward
+                    views.terminated[gi] = bool(terminated)
+                    views.truncated[gi] = bool(truncated)
+                    payloads.append((gi, entries))
+                conn.send(("ok", payloads))
+            elif cmd == "close":
+                for env in envs:
+                    env.close()
+                envs = []
+                conn.send(("ok", None))
+                return
+            else:  # pragma: no cover - protocol bug guard
+                conn.send(("error", f"unknown command {cmd!r}"))
+                return
+    except (EOFError, KeyboardInterrupt):  # parent went away: die quietly
+        return
+    except Exception:
+        try:
+            conn.send(("error", traceback.format_exc()))
+        except Exception:
+            pass
+    finally:
+        for env in envs:
+            try:
+                env.close()
+            except Exception:
+                pass
+        try:
+            conn.close()
+        except Exception:
+            pass

@@ -235,6 +235,24 @@ def make_vector_env(
     ]
     if restart_on_exception:
         thunks = [(lambda fn=fn: RestartOnException(fn)) for fn in thunks]
+
+    # Shared-memory multi-process pool (sheeprl_tpu/rollout): same SAME_STEP
+    # semantics, workers stepping concurrently, watchdog + restart robustness.
+    pool_cfg = cfg.env.get("pool") or {}
+    if pool_cfg.get("enabled", False):
+        from sheeprl_tpu.rollout import EnvPool
+
+        rollout_cfg = cfg.get("rollout") or {}
+        return EnvPool(
+            thunks,
+            num_workers=pool_cfg.get("num_workers"),
+            step_timeout_s=rollout_cfg.get("step_timeout_s", 60.0),
+            heartbeat_interval_s=rollout_cfg.get("heartbeat_interval_s", 2.0),
+            max_restarts=rollout_cfg.get("max_restarts", 3),
+            restart_backoff_s=rollout_cfg.get("restart_backoff_s", 0.5),
+            start_method=rollout_cfg.get("start_method"),
+            autoreset_mode=AutoresetMode.SAME_STEP,
+        )
     vector_cls = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
     return vector_cls(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
 

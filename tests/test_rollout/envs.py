@@ -1,0 +1,40 @@
+"""Misbehaving envs for the EnvPool robustness tests."""
+
+from __future__ import annotations
+
+import os
+import time
+
+from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+
+
+class HangingEnv(DiscreteDummyEnv):
+    """Blocks forever on its ``hang_at``-th step (0 disables) — simulates a wedged
+    simulator; only a process kill gets past it."""
+
+    def __init__(self, hang_at: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self._hang_at = hang_at
+        self._steps_taken = 0
+
+    def step(self, action):
+        self._steps_taken += 1
+        if self._hang_at and self._steps_taken == self._hang_at:
+            time.sleep(3600)
+        return super().step(action)
+
+
+class CrashingEnv(DiscreteDummyEnv):
+    """Kills its own process on the ``crash_at``-th step (0 disables) — simulates a
+    segfault/OOM-killed worker, which no in-process except block can catch."""
+
+    def __init__(self, crash_at: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self._crash_at = crash_at
+        self._steps_taken = 0
+
+    def step(self, action):
+        self._steps_taken += 1
+        if self._crash_at and self._steps_taken == self._crash_at:
+            os._exit(13)
+        return super().step(action)

@@ -1,0 +1,34 @@
+"""End-to-end: a training loop through the real CLI with the EnvPool backend and
+the acting pipeline enabled (env.pool.enabled=True, rollout.pipeline_depth=1)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.cli import run
+
+
+def test_ppo_dry_run_with_envpool_and_pipeline(tmp_path):
+    run(
+        [
+            "exp=ppo",
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.mlp_features_dim=8",
+            "env.pool.enabled=True",
+            "env.pool.num_workers=2",
+            "rollout.pipeline_depth=1",
+            "rollout.step_timeout_s=60",
+            "dry_run=True",
+            "env.num_envs=2",
+            "env.capture_video=False",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            "metric.log_every=1",
+            f"log_root={tmp_path}",
+            "buffer.memmap=False",
+        ]
+    )

@@ -1,0 +1,190 @@
+"""Shared-memory round-trip correctness: EnvPool vs SyncVectorEnv(SAME_STEP).
+
+The pool's contract is bit-equality with the existing ``utils/env.py`` vector
+path under a fixed seed: batched obs layout and values, float64 rewards, bool
+done flags, ``final_obs``/``final_info`` payloads and episode-statistics infos.
+"""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import numpy as np
+import pytest
+from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+from sheeprl_tpu.envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from sheeprl_tpu.rollout import EnvPool
+
+N_ENVS = 3
+EP_LEN = 4  # DiscreteDummyEnv terminates at n_steps+1 -> several boundaries in a short run
+
+
+def _thunks(cls, **kwargs):
+    def mk(i):
+        def thunk():
+            return gym.wrappers.RecordEpisodeStatistics(cls(**kwargs))
+
+        return thunk
+
+    return [mk(i) for i in range(N_ENVS)]
+
+
+def _assert_info_equal(si: dict, pi: dict) -> None:
+    assert set(si) == set(pi)
+    for k in si:
+        sv, pv = si[k], pi[k]
+        if k == "final_obs":
+            for a, b in zip(sv, pv):
+                if a is None:
+                    assert b is None
+                else:
+                    assert set(a) == set(b)
+                    for kk in a:
+                        np.testing.assert_array_equal(a[kk], b[kk])
+        elif isinstance(sv, dict):
+            # episode stats: 't' is wall-clock elapsed time, nondeterministic even
+            # between two SyncVectorEnv instances — compare everything else.
+            def scrub(d):
+                return {
+                    kk: scrub(vv) if isinstance(vv, dict) else np.asarray(vv).tolist()
+                    for kk, vv in d.items()
+                    if kk not in ("t", "_t")
+                }
+
+            assert scrub(sv) == scrub(pv)
+        else:
+            np.testing.assert_array_equal(np.asarray(sv), np.asarray(pv))
+
+
+@pytest.mark.parametrize(
+    "cls,kwargs,sample_space",
+    [
+        (DiscreteDummyEnv, dict(n_steps=EP_LEN, action_dim=3), gym.spaces.Discrete(3)),
+        (MultiDiscreteDummyEnv, dict(n_steps=EP_LEN, action_dims=[2, 3]), gym.spaces.MultiDiscrete([2, 3])),
+        (ContinuousDummyEnv, dict(n_steps=EP_LEN, action_dim=2), gym.spaces.Box(-1.0, 1.0, (2,), np.float32)),
+    ],
+)
+def test_envpool_matches_sync_vector_env(cls, kwargs, sample_space):
+    thunks = _thunks(cls, **kwargs)
+    sync = SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    pool = EnvPool(thunks, num_workers=2, step_timeout_s=30.0)
+    try:
+        so, si = sync.reset(seed=11)
+        po, pi = pool.reset(seed=11)
+        assert set(so) == set(po)
+        for k in so:
+            np.testing.assert_array_equal(so[k], po[k])
+            assert so[k].dtype == po[k].dtype
+        _assert_info_equal(si, pi)
+
+        sample_space.seed(123)
+        for step in range(2 * (EP_LEN + 2)):  # crosses at least one autoreset boundary
+            actions = np.stack([sample_space.sample() for _ in range(N_ENVS)])
+            s_obs, s_rew, s_term, s_trunc, s_info = sync.step(actions.copy())
+            p_obs, p_rew, p_term, p_trunc, p_info = pool.step(actions.copy())
+            for k in s_obs:
+                np.testing.assert_array_equal(s_obs[k], p_obs[k])
+            np.testing.assert_array_equal(s_rew, p_rew)
+            assert s_rew.dtype == p_rew.dtype == np.float64
+            np.testing.assert_array_equal(s_term, p_term)
+            np.testing.assert_array_equal(s_trunc, p_trunc)
+            assert s_term.dtype == p_term.dtype == np.bool_
+            _assert_info_equal(s_info, p_info)
+    finally:
+        sync.close()
+        pool.close()
+
+
+def test_envpool_same_step_autoreset_semantics():
+    """SAME_STEP contract as documented in utils/env.py: on the done step the
+    returned obs is the fresh reset obs and the true final obs rides info."""
+    thunks = _thunks(DiscreteDummyEnv, n_steps=EP_LEN)
+    pool = EnvPool(thunks, num_workers=2, step_timeout_s=30.0)
+    try:
+        obs, _ = pool.reset(seed=0)
+        done_seen = False
+        for _ in range(EP_LEN + 2):
+            obs, rew, term, trunc, info = pool.step(np.zeros(N_ENVS, dtype=np.int64))
+            if term.any():
+                done_seen = True
+                # reset obs on the done step: dummy env restarts its counter at 0
+                assert (obs["state"][term] == 0.0).all()
+                assert "final_obs" in info
+                for i in np.nonzero(term)[0]:
+                    final = info["final_obs"][i]
+                    assert final is not None
+                    # the true final obs carries the last step counter, not 0
+                    assert (np.asarray(final["state"]) != 0.0).all()
+                assert "final_info" in info and "episode" in info["final_info"]
+        assert done_seen
+    finally:
+        pool.close()
+
+
+def test_envpool_reset_seeding_matches_sync():
+    """reset(seed=s) must seed env i with s+i, like gymnasium's vector envs."""
+    thunks = _thunks(DiscreteDummyEnv, n_steps=EP_LEN)
+    sync = SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    pool = EnvPool(thunks, num_workers=3, step_timeout_s=30.0)
+    try:
+        for seed in (0, 42):
+            so, _ = sync.reset(seed=seed)
+            po, _ = pool.reset(seed=seed)
+            for k in so:
+                np.testing.assert_array_equal(so[k], po[k])
+    finally:
+        sync.close()
+        pool.close()
+
+
+def test_envpool_obs_snapshots_do_not_alias():
+    """Returned observations must be copies: callers keep them across steps while
+    workers overwrite the shared slab in place."""
+    thunks = _thunks(DiscreteDummyEnv, n_steps=16)
+    pool = EnvPool(thunks, num_workers=1, step_timeout_s=30.0)
+    try:
+        obs0, _ = pool.reset(seed=0)
+        kept = {k: v.copy() for k, v in obs0.items()}
+        pool.step(np.zeros(N_ENVS, dtype=np.int64))
+        for k in kept:
+            np.testing.assert_array_equal(obs0[k], kept[k])
+    finally:
+        pool.close()
+
+
+def test_envpool_worker_partitioning_and_close():
+    thunks = _thunks(DiscreteDummyEnv, n_steps=EP_LEN)
+    pool = EnvPool(thunks, num_workers=2, step_timeout_s=30.0)
+    sizes = [w.num_envs for w in pool._workers]
+    assert sum(sizes) == N_ENVS and max(sizes) - min(sizes) <= 1
+    pool.reset(seed=0)
+    procs = [w.proc for w in pool._workers]
+    assert all(p.is_alive() for p in procs)
+    pool.close()
+    assert all(not p.is_alive() for p in procs)
+    pool.close()  # idempotent
+
+
+def test_envpool_metrics_shape():
+    thunks = _thunks(DiscreteDummyEnv, n_steps=EP_LEN)
+    pool = EnvPool(thunks, num_workers=2, step_timeout_s=30.0)
+    try:
+        pool.reset(seed=0)
+        pool.step(np.zeros(N_ENVS, dtype=np.int64))
+        m = pool.rollout_metrics()
+        assert m["Rollout/env_steps"] == 1.0
+        assert m["Rollout/worker_restarts"] == 0.0
+        assert m["Rollout/num_workers"] == 2.0
+    finally:
+        pool.close()
+
+
+def test_rollout_metrics_helper_noop_for_plain_envs():
+    from sheeprl_tpu.rollout import rollout_metrics
+
+    thunks = _thunks(DiscreteDummyEnv, n_steps=EP_LEN)
+    sync = SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    try:
+        assert rollout_metrics(sync) == {}
+    finally:
+        sync.close()

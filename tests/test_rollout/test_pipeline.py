@@ -1,0 +1,144 @@
+"""PipelinedPlayer semantics: depth-0 bit-parity with the synchronous acting
+path, and the documented lag/replay behavior at depth >= 1."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+from sheeprl_tpu.rollout import EnvPool, PipelinedPlayer
+
+N_ENVS = 2
+EP_LEN = 5
+
+
+def _thunks():
+    return [lambda: DiscreteDummyEnv(n_steps=EP_LEN, action_dim=2) for _ in range(N_ENVS)]
+
+
+def _make_policy(params_scale=1.0):
+    """A jitted toy policy: action logits from the state obs; deterministic."""
+
+    @jax.jit
+    def policy_fn(state):
+        logits = jnp.stack([jnp.sin(state[:, 0] * params_scale), jnp.cos(state[:, 0])], -1)
+        return logits
+
+    def policy(obs):
+        return policy_fn(jnp.asarray(obs["state"]))
+
+    def post(fetched):
+        logits = np.asarray(fetched)
+        return logits.argmax(-1), logits
+
+    return policy, post
+
+
+def _run_trajectory(envs, player, steps):
+    obs, _ = envs.reset(seed=3)
+    traj = []
+    for _ in range(steps):
+        env_actions, payload, (obs, rew, term, trunc, _info) = player.step(obs)
+        traj.append((env_actions.copy(), payload.copy(), obs["state"].copy(), rew.copy(), term.copy(), trunc.copy()))
+    return traj
+
+
+def test_depth0_trajectory_parity_with_manual_loop():
+    """pipeline_depth=0 must reproduce the hand-rolled dispatch->device_get->step
+    sequence bit for bit (obs, rewards, dones, episode boundaries)."""
+    policy, post = _make_policy()
+
+    # manual synchronous rollout (the historical acting path)
+    envs = SyncVectorEnv(_thunks(), autoreset_mode=AutoresetMode.SAME_STEP)
+    obs, _ = envs.reset(seed=3)
+    manual = []
+    for _ in range(2 * EP_LEN + 3):
+        logits = np.asarray(jax.device_get(policy(obs)))
+        acts = logits.argmax(-1)
+        obs, rew, term, trunc, _info = envs.step(acts)
+        manual.append((acts.copy(), logits.copy(), obs["state"].copy(), rew.copy(), term.copy(), trunc.copy()))
+    envs.close()
+
+    # the same through PipelinedPlayer at depth 0, over an EnvPool
+    pool = EnvPool(_thunks(), num_workers=2, step_timeout_s=30.0)
+    player = PipelinedPlayer(pool, policy, post, depth=0)
+    piped = _run_trajectory(pool, player, 2 * EP_LEN + 3)
+    pool.close()
+
+    for step, (m, p) in enumerate(zip(manual, piped)):
+        for j, name in enumerate(("actions", "logits", "state", "rewards", "terminated", "truncated")):
+            np.testing.assert_array_equal(m[j], p[j], err_msg=f"step {step}: {name}")
+
+
+def test_depth1_replays_then_lags():
+    """depth=1: step 0 acts on obs 0; step 1 replays the initial action while the
+    pipeline fills; step t>=2 applies the action computed from obs t-1."""
+    dispatched = []
+
+    def policy(obs):
+        dispatched.append(float(obs["state"][0, 0]))
+        return jnp.asarray(obs["state"][:, 0].astype(np.int64) % 2)
+
+    def post(fetched):
+        a = np.asarray(fetched)
+        return a, a
+
+    pool = EnvPool(_thunks(), num_workers=2, step_timeout_s=30.0)
+    player = PipelinedPlayer(pool, policy, post, depth=1)
+    obs, _ = pool.reset(seed=0)
+    applied = []
+    for _ in range(5):
+        env_actions, _payload, (obs, *_rest) = player.step(obs)
+        applied.append(int(env_actions[0]))
+    pool.close()
+
+    # the policy was dispatched on every (fresh) observation...
+    assert dispatched == [0.0, 1.0, 2.0, 3.0, 4.0]
+    # ...but the applied action stream is: fresh, replay, then lag-1.
+    assert applied == [0, 0, 1, 0, 1]
+
+
+def test_depth_validation_and_reset():
+    policy, post = _make_policy()
+    pool = EnvPool(_thunks(), num_workers=1, step_timeout_s=30.0)
+    try:
+        import pytest
+
+        with pytest.raises(ValueError):
+            PipelinedPlayer(pool, policy, post, depth=-1)
+        player = PipelinedPlayer(pool, policy, post, depth=2)
+        obs, _ = pool.reset(seed=0)
+        player.act(obs)
+        assert len(player._queue) == 1
+        player.reset_pipeline()
+        assert len(player._queue) == 0
+    finally:
+        pool.close()
+
+
+def test_act_env_step_split_matches_combined():
+    """The two-phase API (act + env_step, used by dreamer_v3 to keep the train
+    dispatch between them) yields the same trajectory as combined step()."""
+    policy, post = _make_policy()
+
+    pool_a = EnvPool(_thunks(), num_workers=2, step_timeout_s=30.0)
+    player_a = PipelinedPlayer(pool_a, policy, post, depth=0)
+    combined = _run_trajectory(pool_a, player_a, EP_LEN + 2)
+    pool_a.close()
+
+    pool_b = EnvPool(_thunks(), num_workers=2, step_timeout_s=30.0)
+    player_b = PipelinedPlayer(pool_b, policy, post, depth=0)
+    obs, _ = pool_b.reset(seed=3)
+    split = []
+    for _ in range(EP_LEN + 2):
+        env_actions, payload = player_b.act(obs)
+        obs, rew, term, trunc, _info = player_b.env_step(env_actions)
+        split.append((env_actions.copy(), payload.copy(), obs["state"].copy(), rew.copy(), term.copy(), trunc.copy()))
+    pool_b.close()
+
+    for m, p in zip(combined, split):
+        for j in range(6):
+            np.testing.assert_array_equal(m[j], p[j])

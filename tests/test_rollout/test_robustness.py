@@ -1,0 +1,98 @@
+"""Watchdog / restart / abort behavior of the EnvPool robustness layer."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.rollout import EnvPool, RolloutAbortError
+from tests.test_rollout.envs import CrashingEnv, HangingEnv
+
+
+def test_watchdog_restarts_hung_worker(recwarn):
+    """A worker stuck inside env.step past step_timeout_s is killed and replaced;
+    its envs surface the break as truncated=True + info['rollout_restart']."""
+    thunks = [
+        lambda: HangingEnv(hang_at=2, n_steps=32),
+        lambda: HangingEnv(hang_at=0, n_steps=32),
+    ]
+    pool = EnvPool(thunks, num_workers=2, step_timeout_s=1.5, max_restarts=2, restart_backoff_s=0.0)
+    try:
+        obs, _ = pool.reset(seed=5)
+        obs, rew, term, trunc, info = pool.step(np.zeros(2, np.int64))
+        assert not trunc.any()
+        obs, rew, term, trunc, info = pool.step(np.zeros(2, np.int64))  # env 0 hangs here
+        assert trunc[0] and not trunc[1]
+        assert not term.any()
+        assert rew[0] == 0.0
+        assert info["rollout_restart"][0] and not info["rollout_restart"][1]
+        # the restarted env delivered a fresh reset obs; the healthy one kept going
+        assert obs["state"][0, 0] == 0.0
+        assert obs["state"][1, 0] == 2.0
+        m = pool.rollout_metrics()
+        assert m["Rollout/worker_restarts"] == 1.0
+        assert m["Rollout/worker_timeouts"] == 1.0
+        # pool keeps stepping after the restart
+        obs, *_ = pool.step(np.zeros(2, np.int64))
+        assert obs["state"][1, 0] == 3.0
+    finally:
+        pool.close(terminate=True)
+
+
+def test_watchdog_restarts_crashed_worker(recwarn):
+    """A worker process that dies outright (os._exit inside env.step) is detected
+    without waiting for the full step timeout and restarted."""
+    thunks = [lambda: CrashingEnv(crash_at=2, n_steps=32)]
+    pool = EnvPool(thunks, num_workers=1, step_timeout_s=30.0, max_restarts=2, restart_backoff_s=0.0)
+    try:
+        pool.reset(seed=1)
+        pool.step(np.zeros(1, np.int64))
+        obs, rew, term, trunc, info = pool.step(np.zeros(1, np.int64))  # crash
+        assert trunc[0]
+        assert info["rollout_restart"][0]
+        m = pool.rollout_metrics()
+        assert m["Rollout/worker_restarts"] == 1.0
+        assert m["Rollout/worker_crashes"] == 1.0
+    finally:
+        pool.close(terminate=True)
+
+
+def test_max_restarts_budget_aborts(recwarn):
+    """Past the restart budget the pool tears down and raises RolloutAbortError."""
+    thunks = [lambda: CrashingEnv(crash_at=1, n_steps=32)]
+    pool = EnvPool(thunks, num_workers=1, step_timeout_s=30.0, max_restarts=0, restart_backoff_s=0.0)
+    pool.reset(seed=0)
+    with pytest.raises(RolloutAbortError):
+        pool.step(np.zeros(1, np.int64))
+    assert pool.closed
+    assert all(w.proc is None or not w.proc.is_alive() for w in pool._workers)
+
+
+def test_restart_reseeds_with_generation_offset(recwarn):
+    """Replacement workers reset with base_seed + generation * stride, so a
+    restarted env does not replay the exact pre-crash episode stream."""
+    thunks = [lambda: CrashingEnv(crash_at=3, n_steps=32)]
+    pool = EnvPool(thunks, num_workers=1, step_timeout_s=30.0, max_restarts=3, restart_backoff_s=0.0)
+    try:
+        pool.reset(seed=7)
+        assert pool._env_seeds == [7]
+        for _ in range(3):
+            pool.step(np.zeros(1, np.int64))
+        w = pool._workers[0]
+        assert w.generation == 1
+        assert pool._worker_seeds(w) == [7 + 7919]
+    finally:
+        pool.close(terminate=True)
+
+
+def test_heartbeat_ages_are_fresh():
+    thunks = [lambda: HangingEnv(hang_at=0, n_steps=32)]
+    pool = EnvPool(thunks, num_workers=1, step_timeout_s=30.0, heartbeat_interval_s=0.05)
+    try:
+        pool.reset(seed=0)
+        ages = pool.heartbeat_ages()
+        assert ages.shape == (1,)
+        assert np.isfinite(ages).all()
+        assert (ages < 10.0).all()
+    finally:
+        pool.close(terminate=True)

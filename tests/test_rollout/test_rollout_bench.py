@@ -1,0 +1,51 @@
+"""Tier-1 smoke of benchmarks/rollout_bench.py: tiny dummy-env invocation, JSON
+row shape compatible with the BENCH_*.json trajectory."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _load_bench_module():
+    bench_dir = os.path.join(os.path.dirname(__file__), "..", "..", "benchmarks")
+    sys.path.insert(0, os.path.abspath(bench_dir))
+    try:
+        import rollout_bench
+    finally:
+        sys.path.pop(0)
+    return rollout_bench
+
+
+def test_rollout_bench_smoke(capsys, tmp_path):
+    rollout_bench = _load_bench_module()
+    out_path = tmp_path / "rollout_bench.json"
+    rates = rollout_bench.main(
+        [
+            "--num-envs", "2",
+            "--steps", "4",
+            "--warmup-steps", "1",
+            "--step-ms", "0",
+            "--screen-size", "16",
+            "--ep-len", "8",
+            "--backends", "sync,pool",
+            "--json-out", str(out_path),
+        ]
+    )
+    assert set(rates) == {"sync", "pool"}
+    assert all(v > 0 for v in rates.values())
+
+    # stdout: one JSON object per line, BENCH_*-style rows
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    rows = [json.loads(ln) for ln in lines]
+    metrics = {r["metric"] for r in rows}
+    assert "rollout_env_steps_per_sec_sync" in metrics
+    assert "rollout_env_steps_per_sec_pool" in metrics
+    assert "rollout_envpool_speedup_vs_sync" in metrics
+    for r in rows:
+        assert {"metric", "value", "unit"} <= set(r)
+        assert isinstance(r["value"], (int, float))
+
+    saved = json.loads(out_path.read_text())
+    assert [r["metric"] for r in saved] == [r["metric"] for r in rows]

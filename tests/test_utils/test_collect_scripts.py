@@ -1,0 +1,68 @@
+"""Guards for the LEARNING_r05 collector scripts: incomplete-run flagging and
+the merge-preserving additional_runs write."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+BENCH_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "benchmarks")
+
+
+def _collect_r05():
+    sys.path.insert(0, os.path.abspath(BENCH_DIR))
+    try:
+        import collect_r05
+    finally:
+        sys.path.pop(0)
+    return collect_r05
+
+
+def test_flag_incomplete_marks_truncated_runs():
+    c = _collect_r05()
+    run = {
+        "policy_steps": 62500,
+        "train_reward_curve": [[2000, 103.97]],
+        "final_test_reward": None,
+        "notes": "configured for 500K env frames",
+    }
+    c.flag_incomplete(run)
+    assert run["incomplete"] is True
+    assert "RUN INCOMPLETE" in run["notes"]
+    assert "2000 of 62500" in run["notes"]
+    # idempotent: re-flagging does not duplicate the suffix
+    notes = run["notes"]
+    c.flag_incomplete(run)
+    assert run["notes"] == notes
+
+
+def test_flag_incomplete_leaves_complete_runs_alone():
+    c = _collect_r05()
+    run = {
+        "policy_steps": 262144,
+        "train_reward_curve": [[262144, 441.38]],
+        "final_test_reward": 500.0,
+        "notes": "fine",
+    }
+    c.flag_incomplete(run)
+    assert "incomplete" not in run
+    assert run["notes"] == "fine"
+    # no curve and no total: nothing to compare, nothing flagged
+    empty = {"policy_steps": 0, "train_reward_curve": []}
+    c.flag_incomplete(empty)
+    assert "incomplete" not in empty
+
+
+def test_committed_learning_r05_flags_the_truncated_sac_ae_run():
+    path = os.path.join(BENCH_DIR, "..", "LEARNING_r05.json")
+    with open(path) as f:
+        data = json.load(f)
+    by_label = {r["label"]: r for r in data["additional_runs"]}
+    sac_ae = by_label["sac_ae_cartpole_r5"]
+    assert sac_ae.get("incomplete") is True
+    assert "RUN INCOMPLETE" in sac_ae["notes"]
+    # every other merged run is complete and unflagged
+    for label, run in by_label.items():
+        if label != "sac_ae_cartpole_r5":
+            assert "incomplete" not in run, label
