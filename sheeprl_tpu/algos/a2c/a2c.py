@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.analysis.strict import assert_finite, strict_guard
 from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, value_loss
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
@@ -107,6 +108,9 @@ def main(ctx, cfg) -> None:
         updates, o_state = opt.update(grads, o_state, p)
         return optax.apply_updates(p, updates), o_state, aux
 
+    # analysis.strict: signature guard on the jitted update (drift -> hard error)
+    train_fn = strict_guard(cfg, "a2c/train_fn", train_fn)
+
     start_update, policy_step, last_log, last_checkpoint = 1, 0, 0, 0
     if cfg.checkpoint.get("resume_from"):
         state = CheckpointManager.load(
@@ -178,6 +182,7 @@ def main(ctx, cfg) -> None:
             params, opt_state, train_metrics = train_fn(params, opt_state, data)
             train_metrics = jax.device_get(train_metrics)
             train_time = time.perf_counter() - t0
+        assert_finite(cfg, train_metrics, "a2c/update")
         for k, v in train_metrics.items():
             aggregator.update(k, float(v))
 

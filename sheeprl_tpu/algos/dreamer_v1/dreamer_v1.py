@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled
 from sheeprl_tpu.algos.dreamer_v1.agent import (
     PlayerState,
     WorldModelV1,
@@ -200,6 +201,8 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys):
         metrics["Grads/world_model"] = optax.global_norm(wm_grads)
         metrics["Grads/actor"] = optax.global_norm(actor_grads)
         metrics["Grads/critic"] = optax.global_norm(critic_grads)
+        if strict_enabled(cfg):  # trace-time constant: callback exists only in strict runs
+            nan_scan(metrics, "dreamer_v1/train_step")
         return new_params, new_opt_states, metrics
 
     return train_step, init_opt_states

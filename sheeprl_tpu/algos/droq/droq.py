@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.analysis.strict import strict_guard
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.sac.agent import SACActor
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss
@@ -195,6 +196,9 @@ def main(ctx, cfg) -> None:
         (p, o_state, _), closses = jax.lax.scan(step, (p, o_state, grad_step0), batches)
         return p, o_state, closses.mean()
 
+    # analysis.strict: signature guard on the jitted critic update
+    train_critics_fn = strict_guard(cfg, "droq/train_critics_fn", train_critics_fn)
+
     @jax.jit
     def train_actor_fn(p, o_state, batch, key):
         k_act, k_drop = jax.random.split(key)
@@ -216,6 +220,8 @@ def main(ctx, cfg) -> None:
         t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
         p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
         return p, {**o_state, "actor": new_a_state, "alpha": new_t_state}, al, tl
+
+    train_actor_fn = strict_guard(cfg, "droq/train_actor_fn", train_actor_fn)
 
     policy_steps_per_iter = num_envs * world
     total_steps = int(cfg.algo.total_steps)

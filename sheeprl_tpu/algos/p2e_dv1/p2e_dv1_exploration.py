@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled
 from sheeprl_tpu.algos.dreamer_v1.agent import WorldModelV1
 from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.agent import exploration_amount
@@ -271,6 +272,8 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
         metrics["Loss/value_loss_exploration"] = value_loss_expl
         metrics["Loss/policy_loss_task"] = policy_loss_task
         metrics["Loss/value_loss_task"] = value_loss_task
+        if strict_enabled(cfg):  # trace-time constant: callback exists only in strict runs
+            nan_scan(metrics, "p2e_dv1/train_step")
         return new_params, new_opt_states, metrics
 
     return train_step, init_opt_states

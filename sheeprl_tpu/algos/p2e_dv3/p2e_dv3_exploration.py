@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled
 from sheeprl_tpu.algos.dreamer_v3.agent import PlayerState, WorldModel, make_player_step
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.p2e import ensemble_loss, intrinsic_reward
@@ -405,6 +406,8 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
         metrics["Loss/policy_loss_exploration"] = policy_loss_expl
         metrics["Loss/policy_loss_task"] = policy_loss_task
         metrics["Loss/value_loss_task"] = value_loss_task
+        if strict_enabled(cfg):  # trace-time constant: callback exists only in strict runs
+            nan_scan(metrics, "p2e_dv3/train_step")
         return new_params, new_opt_states, new_moments, metrics
 
     return train_step, init_opt_states, init_moments_state

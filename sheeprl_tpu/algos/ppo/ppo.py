@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.analysis.strict import assert_finite, nan_scan, strict_enabled, strict_guard
 from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import (
@@ -111,6 +112,7 @@ class PPOTrainFns:
         mb_size = cfg.algo.per_rank_batch_size
         num_minibatches = self.num_minibatches
         opt = self.opt
+        strict = strict_enabled(cfg)
 
         @jax.jit
         def act_fn(p, obs, key):
@@ -157,7 +159,10 @@ class PPOTrainFns:
 
             keys = jax.random.split(key, cfg.algo.update_epochs)
             (p, o_state), metrics = jax.lax.scan(epoch_step, (p, o_state), keys)
-            return p, o_state, jax.tree.map(jnp.mean, metrics)
+            metrics = jax.tree.map(jnp.mean, metrics)
+            if strict:  # trace-time constant: the callback only exists in strict runs
+                nan_scan(metrics, "ppo/train_fn")
+            return p, o_state, metrics
 
         self.act_fn = act_fn
         self.values_fn = values_fn
@@ -216,6 +221,8 @@ def main(ctx, cfg) -> None:
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
 
     act_fn, values_fn, train_fn, gae_fn = fns.act_fn, fns.values_fn, fns.train_fn, fns.gae_fn
+    # analysis.strict: signature guard on the jitted update (drift -> hard error)
+    train_fn = strict_guard(cfg, "ppo/train_fn", train_fn)
     gamma = cfg.algo.gamma
 
     # ------------------------------------------------------------------ resume
@@ -330,6 +337,7 @@ def main(ctx, cfg) -> None:
             params, opt_state, train_metrics = train_fn(params, opt_state, data, ctx.rng(), clip_coef, ent_coef)
             train_metrics = jax.device_get(train_metrics)
             train_time = time.perf_counter() - t0
+        assert_finite(cfg, train_metrics, "ppo/update")
         for k, v in train_metrics.items():
             aggregator.update(k, float(v))
 

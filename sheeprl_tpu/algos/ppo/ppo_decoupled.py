@@ -36,6 +36,7 @@ from typing import Any, Dict
 import jax
 import numpy as np
 
+from sheeprl_tpu.analysis.strict import assert_finite, strict_guard
 from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
@@ -82,6 +83,7 @@ def main(ctx, cfg) -> None:
     grad_steps_per_update = fns.grad_steps_per_update
     opt_state = ctx.replicate(fns.opt.init(params))
     act_fn, values_fn, train_fn, gae_fn = fns.act_fn, fns.values_fn, fns.train_fn, fns.gae_fn
+    train_fn = strict_guard(cfg, "ppo_decoupled/train_fn", train_fn)
     gamma = cfg.algo.gamma
 
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
@@ -240,6 +242,7 @@ def main(ctx, cfg) -> None:
                 param_q.put(params)
                 train_metrics = jax.device_get(train_metrics)
                 train_time = time.perf_counter() - t0
+            assert_finite(cfg, train_metrics, "ppo_decoupled/update")
             with agg_lock:
                 for k, v in train_metrics.items():
                     aggregator.update(k, float(v))

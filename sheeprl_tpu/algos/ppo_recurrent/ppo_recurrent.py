@@ -21,6 +21,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, log_prob_and_entropy, prepare_obs, sample_actions
 from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, build_agent, make_zero_state
+from sheeprl_tpu.analysis.strict import assert_finite, strict_guard
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -153,6 +154,9 @@ def main(ctx, cfg) -> None:
         (p, o_state), metrics = jax.lax.scan(epoch_step, (p, o_state), keys)
         return p, o_state, jax.tree.map(jnp.mean, metrics)
 
+    # analysis.strict: signature guard on the jitted update (drift -> hard error)
+    train_fn = strict_guard(cfg, "ppo_recurrent/train_fn", train_fn)
+
     start_update, policy_step, last_log, last_checkpoint = 1, 0, 0, 0
     if cfg.checkpoint.get("resume_from"):
         state = CheckpointManager.load(
@@ -272,6 +276,7 @@ def main(ctx, cfg) -> None:
             )
             train_metrics = jax.device_get(train_metrics)
             train_time = time.perf_counter() - t0
+        assert_finite(cfg, train_metrics, "ppo_recurrent/update")
         for k, v in train_metrics.items():
             aggregator.update(k, float(v))
 

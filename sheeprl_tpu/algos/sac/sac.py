@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled, strict_guard
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.sac.agent import build_agent
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
@@ -47,6 +48,7 @@ def make_sac_train_fn(actor, critic, cfg, act_space):
     tau = cfg.algo.tau
     gamma = cfg.algo.gamma
 
+    strict = strict_enabled(cfg)
     actor_opt = make_optimizer(cfg.algo.actor.optimizer, cfg.algo.get("max_grad_norm", 0.0))
     critic_opt = make_optimizer(cfg.algo.critic.optimizer, cfg.algo.get("max_grad_norm", 0.0))
     alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
@@ -128,7 +130,10 @@ def make_sac_train_fn(actor, critic, cfg, act_space):
         g = batches["obs"].shape[0]
         batches["_key"] = jax.random.split(key, g)
         (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, grad_step0), batches)
-        return p, o_state, jax.tree.map(jnp.mean, metrics)
+        metrics = jax.tree.map(jnp.mean, metrics)
+        if strict:  # trace-time constant: the callback only exists in strict runs
+            nan_scan(metrics, "sac/train_fn")
+        return p, o_state, metrics
 
     return actor_opt, critic_opt, alpha_opt, train_fn
 
@@ -151,6 +156,7 @@ def main(ctx, cfg) -> None:
 
     actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
     actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
+    train_fn = strict_guard(cfg, "sac/train_fn", train_fn)
     opt_state = ctx.replicate(
         {
             "actor": actor_opt.init(params["actor"]),

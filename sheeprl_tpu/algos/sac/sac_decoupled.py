@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.analysis.strict import assert_finite, strict_guard
 from sheeprl_tpu.algos.sac.agent import build_agent
 from sheeprl_tpu.algos.sac.sac import make_sac_train_fn
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
@@ -59,6 +60,7 @@ def main(ctx, cfg) -> None:
 
     actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
     actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
+    train_fn = strict_guard(cfg, "sac_decoupled/train_fn", train_fn)
     opt_state = ctx.replicate(
         {
             "actor": actor_opt.init(params["actor"]),
@@ -258,6 +260,7 @@ def main(ctx, cfg) -> None:
                         pass
                     param_q.put(params)
                     train_metrics = jax.device_get(train_metrics)
+                    assert_finite(cfg, train_metrics, "sac_decoupled/update")
                     train_time = time.perf_counter() - t0
                 cumulative_grad_steps += grad_steps
                 with agg_lock:

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled, strict_guard
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
 from sheeprl_tpu.algos.sac_ae.agent import build_agent, preprocess_obs
@@ -255,7 +256,13 @@ def main(ctx, cfg) -> None:
         g = batches["obs"].shape[0]
         batches["_key"] = jax.random.split(key, g)
         (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, step0), batches)
-        return p, o_state, jax.tree.map(jnp.mean, metrics)
+        metrics = jax.tree.map(jnp.mean, metrics)
+        if strict_enabled(cfg):  # trace-time constant
+            nan_scan(metrics, "sac_ae/train_fn")
+        return p, o_state, metrics
+
+    # analysis.strict: signature guard on the jitted update (drift -> hard error)
+    train_fn = strict_guard(cfg, "sac_ae/train_fn", train_fn)
 
     policy_steps_per_iter = num_envs * world
     total_steps = int(cfg.algo.total_steps)

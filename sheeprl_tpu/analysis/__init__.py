@@ -1,0 +1,49 @@
+"""jaxlint: JAX-aware static analysis + runtime strict mode for the training stack.
+
+Static half (``python -m sheeprl_tpu.analysis [paths]``): AST rules JL001–JL006 over
+the codebase, with ``# jaxlint: disable=RULE`` suppressions and a checked-in
+``jaxlint.baseline`` of intentional exceptions so CI fails only on *new* violations.
+
+Runtime half (``analysis.strict=True`` in the config tree): shape/dtype guards on
+registered jit entry points, a NaN/Inf scan at the update boundary, and the ``obs``
+recompile watchdog upgraded from warning to hard error.  See
+``howto/static_analysis.md``.
+"""
+
+from sheeprl_tpu.analysis.engine import (
+    Finding,
+    Rule,
+    filter_baseline,
+    load_baseline,
+    parse_suppressions,
+    run_lint,
+    write_baseline,
+)
+from sheeprl_tpu.analysis.strict import (
+    NonFiniteError,
+    SignatureDriftError,
+    StrictModeError,
+    assert_finite,
+    nan_scan,
+    raise_pending,
+    strict_enabled,
+    strict_guard,
+)
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "run_lint",
+    "load_baseline",
+    "write_baseline",
+    "filter_baseline",
+    "parse_suppressions",
+    "StrictModeError",
+    "SignatureDriftError",
+    "NonFiniteError",
+    "strict_enabled",
+    "strict_guard",
+    "assert_finite",
+    "nan_scan",
+    "raise_pending",
+]

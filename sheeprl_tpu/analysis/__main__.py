@@ -1,0 +1,75 @@
+"""``python -m sheeprl_tpu.analysis [paths...]`` — the jaxlint CLI.
+
+Exit status: 0 when no findings survive the baseline, 1 otherwise, 2 on usage errors.
+
+    python -m sheeprl_tpu.analysis sheeprl_tpu/               # lint against jaxlint.baseline
+    python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/ # everything, baseline ignored
+    python -m sheeprl_tpu.analysis --write-baseline sheeprl_tpu/  # accept current findings
+    python -m sheeprl_tpu.analysis --select JL006 sheeprl_tpu/    # one rule only
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from sheeprl_tpu.analysis.engine import load_baseline, run_lint, write_baseline
+from sheeprl_tpu.analysis.rules import default_rules
+
+DEFAULT_BASELINE = "jaxlint.baseline"
+
+
+def _default_config_dir() -> Optional[Path]:
+    p = Path(__file__).resolve().parents[1] / "config" / "configs"
+    return p if p.is_dir() else None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis",
+        description="jaxlint: JAX-aware static analysis (rules JL001-JL006) for sheeprl-tpu.",
+    )
+    parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files or directories to lint")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE, help="baseline file of accepted fingerprints")
+    parser.add_argument("--no-baseline", action="store_true", help="ignore the baseline entirely")
+    parser.add_argument(
+        "--write-baseline", action="store_true", help="write all current findings to the baseline and exit 0"
+    )
+    parser.add_argument("--select", default=None, help="comma-separated rule ids to run (default: all)")
+    parser.add_argument(
+        "--config-dir", default=None, help="YAML config tree for JL006 (default: the package's config/configs)"
+    )
+    parser.add_argument("--root", default=".", help="directory paths are reported relative to")
+    parser.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    args = parser.parse_args(argv)
+
+    try:
+        rules = default_rules(args.select.split(",")) if args.select else default_rules()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    config_dir = Path(args.config_dir) if args.config_dir else _default_config_dir()
+    baseline = None if (args.no_baseline or args.write_baseline) else load_baseline(args.baseline)
+
+    findings = run_lint(args.paths, rules=rules, config_dir=config_dir, baseline=baseline, root=args.root)
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        if not args.quiet:
+            print(f"jaxlint: wrote {len(findings)} fingerprint(s) to {args.baseline}")
+        return 0
+
+    for f in findings:
+        print(f.render())
+    if not args.quiet:
+        n_base = len(baseline) if baseline else 0
+        status = "ok" if not findings else f"{len(findings)} finding(s)"
+        print(f"jaxlint: {status} ({n_base} baselined)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""Merged-YAML-tree index for the JL006 config-drift rule.
+
+Mirrors the composition semantics of :mod:`sheeprl_tpu.config.core` *unionally*: every
+group file merges its keys under the group's mount key (last path component of its
+directory), ``exp/`` and ``_global_: true`` files merge at the root, and the root
+``config.yaml`` merges at the root.  The union over all options per group (rather than
+any single composition) is the right "defined" set for drift checks: a key is only
+*undefined* if **no** selectable option defines it.
+
+Also records every ``${a.b.c}`` interpolation in the YAML text as an *access*, so
+config keys consumed only by other config values don't show up as dead.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+import yaml
+
+PathTuple = Tuple[str, ...]
+
+_INTERP_RE = re.compile(r"\$\{([^${}]+)\}")
+
+
+@dataclass
+class ConfigIndex:
+    #: every defined dotted path -> (yaml file relpath, line) of its first definition
+    defined: Dict[PathTuple, Tuple[str, int]] = field(default_factory=dict)
+    #: paths referenced by ${...} interpolation inside the YAML tree
+    interp_accessed: Set[PathTuple] = field(default_factory=set)
+    #: mount keys of the config groups (algo, env, ...)
+    groups: Set[str] = field(default_factory=set)
+
+    def is_defined(self, path: PathTuple) -> bool:
+        return path in self.defined
+
+    def longest_defined_prefix(self, path: PathTuple) -> PathTuple:
+        for i in range(len(path), 0, -1):
+            if path[:i] in self.defined:
+                return path[:i]
+        return ()
+
+
+def _collect_paths(node: yaml.Node, prefix: PathTuple, out: Dict[PathTuple, int]) -> None:
+    if not isinstance(node, yaml.MappingNode):
+        return
+    for key_node, value_node in node.value:
+        if not isinstance(key_node, yaml.ScalarNode):
+            continue
+        key = str(key_node.value)
+        path = prefix + (key,)
+        out.setdefault(path, key_node.start_mark.line + 1)
+        _collect_paths(value_node, path, out)
+
+
+def build_config_index(config_dir: Path, root: Path | None = None) -> ConfigIndex:
+    index = ConfigIndex()
+    config_dir = Path(config_dir)
+    rel_root = root or config_dir
+    for yaml_path in sorted(config_dir.rglob("*.yaml")):
+        rel_dir = yaml_path.parent.relative_to(config_dir).as_posix()
+        group = "" if rel_dir == "." else rel_dir
+        text = yaml_path.read_text()
+        try:
+            node = yaml.compose(text, Loader=yaml.SafeLoader)
+        except yaml.YAMLError:
+            continue
+        try:
+            relpath = yaml_path.resolve().relative_to(Path(rel_root).resolve()).as_posix()
+        except ValueError:
+            relpath = yaml_path.as_posix()
+
+        paths: Dict[PathTuple, int] = {}
+        if node is not None:
+            _collect_paths(node, (), paths)
+
+        raw_global = False
+        if ("_global_",) in paths:
+            # honour the file's actual value, not mere key presence
+            try:
+                raw_global = bool((yaml.safe_load(text) or {}).get("_global_", False))
+            except yaml.YAMLError:
+                raw_global = False
+        is_global = group.split("/")[0] == "exp" or raw_global
+
+        mount: PathTuple = ()
+        if group and not is_global:
+            mount = (group.split("/")[-1],)
+            index.groups.add(mount[0])
+
+        for path, line in paths.items():
+            if path[0] in ("defaults", "_global_"):
+                continue
+            index.defined.setdefault(mount + path, (relpath, line))
+        if mount:
+            index.defined.setdefault(mount, (relpath, 1))
+
+        for m in _INTERP_RE.finditer(text):
+            target = m.group(1).strip()
+            if target.startswith(("oc.env:", "env:")):
+                continue
+            index.interp_accessed.add(tuple(target.split(".")))
+    return index

@@ -1,0 +1,217 @@
+"""jaxlint engine: findings, suppressions, baselines, and the file walker.
+
+The rules themselves live in :mod:`sheeprl_tpu.analysis.rules`; this module owns the
+machinery every rule shares:
+
+* :class:`Finding` — one diagnostic with a stable ``fingerprint`` (rule + file +
+  rule-chosen detail token, deliberately *without* the line number so baselines
+  survive unrelated edits);
+* suppression comments — ``# jaxlint: disable=JL001`` (or ``disable=JL001,JL004`` /
+  ``disable=all``) on the offending line, or on a standalone comment line directly
+  above it;
+* the baseline — a checked-in text file of fingerprints for *intentional* violations,
+  so CI starts green and fails only on new findings;
+* :func:`run_lint` — parse every ``.py`` file under the given paths, run the file
+  rules per module and the project rules (config drift) once over the whole set.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+_SUPPRESS_MARKER = "jaxlint:"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic.  ``detail`` is a rule-chosen stable token (a config key, a
+    ``function:variable`` pair, ...) used for baseline fingerprints instead of the
+    line number, which churns with every unrelated edit."""
+
+    rule: str  # "JL001"
+    path: str  # repo-relative, posix separators
+    line: int  # 1-based
+    col: int
+    message: str
+    detail: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule} {self.path} {self.detail}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class Module:
+    """A parsed source file handed to the rules."""
+
+    path: str  # repo-relative
+    abspath: Path
+    source: str
+    tree: ast.AST
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def suppressed(self, finding: Finding) -> bool:
+        rules = self.suppressions.get(finding.line, ())
+        return finding.rule in rules or "all" in rules
+
+
+class Rule:
+    """Base class.  ``scope`` is ``"file"`` (checked per module) or ``"project"``
+    (checked once with every module, e.g. config drift)."""
+
+    id: str = "JL000"
+    name: str = ""
+    scope: str = "file"
+
+    def check_module(self, module: Module) -> List[Finding]:  # file-scope rules
+        return []
+
+    def check_project(self, modules: Sequence[Module], config_dir: Optional[Path]) -> List[Finding]:
+        return []
+
+
+# --------------------------------------------------------------------- suppressions
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map line number -> set of suppressed rule ids (or ``{"all"}``).
+
+    A trailing comment suppresses its own line; a comment-only line suppresses the
+    next line that contains code.
+    """
+    out: Dict[int, Set[str]] = {}
+    lines = source.splitlines()
+
+    def code_on_line(lineno: int) -> bool:
+        text = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+        before_comment = text.split("#", 1)[0]
+        return bool(before_comment.strip())
+
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        body = tok.string.lstrip("#").strip()
+        if not body.startswith(_SUPPRESS_MARKER):
+            continue
+        directive = body[len(_SUPPRESS_MARKER) :].strip()
+        if not directive.startswith("disable"):
+            continue
+        _, _, spec = directive.partition("=")
+        rules = set()
+        for token in spec.split(","):
+            token = token.strip().split()[0] if token.strip() else ""  # tolerate trailing prose
+            if token:
+                rules.add("all" if token == "all" else token.upper())
+        if not rules:
+            continue
+        lineno = tok.start[0]
+        if code_on_line(lineno):
+            target = lineno
+        else:  # standalone comment: applies to the next line holding code
+            target = lineno + 1
+            while target <= len(lines) and not code_on_line(target):
+                target += 1
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+# ------------------------------------------------------------------------ baseline
+BASELINE_HEADER = "# jaxlint baseline v1 — one fingerprint per line: RULE path detail"
+
+
+def load_baseline(path: os.PathLike) -> Set[str]:
+    p = Path(path)
+    if not p.is_file():
+        return set()
+    out: Set[str] = set()
+    for raw in p.read_text().splitlines():
+        line = raw.strip()
+        if line and not line.startswith("#"):
+            out.add(line)
+    return out
+
+
+def write_baseline(findings: Iterable[Finding], path: os.PathLike) -> None:
+    lines = sorted({f.fingerprint for f in findings})
+    Path(path).write_text(BASELINE_HEADER + "\n" + "\n".join(lines) + "\n")
+
+
+def filter_baseline(findings: Sequence[Finding], baseline: Set[str]) -> List[Finding]:
+    return [f for f in findings if f.fingerprint not in baseline]
+
+
+# -------------------------------------------------------------------------- walker
+def _iter_py_files(paths: Sequence[os.PathLike]) -> Iterable[Path]:
+    for entry in paths:
+        p = Path(entry)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+
+
+def _relpath(p: Path, root: Path) -> str:
+    try:
+        return p.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def load_modules(paths: Sequence[os.PathLike], root: Optional[os.PathLike] = None) -> List[Module]:
+    root_path = Path(root) if root is not None else Path.cwd()
+    modules: List[Module] = []
+    for p in _iter_py_files(paths):
+        try:
+            source = p.read_text()
+            tree = ast.parse(source, filename=str(p))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue  # unparseable files are not lintable; leave them to the test suite
+        modules.append(
+            Module(
+                path=_relpath(p, root_path),
+                abspath=p,
+                source=source,
+                tree=tree,
+                suppressions=parse_suppressions(source),
+            )
+        )
+    return modules
+
+
+def run_lint(
+    paths: Sequence[os.PathLike],
+    rules: Optional[Sequence[Rule]] = None,
+    config_dir: Optional[os.PathLike] = None,
+    baseline: Optional[Set[str]] = None,
+    root: Optional[os.PathLike] = None,
+) -> List[Finding]:
+    """Lint ``paths`` and return findings (suppressions and baseline already applied)."""
+    if rules is None:
+        from sheeprl_tpu.analysis.rules import default_rules
+
+        rules = default_rules()
+    modules = load_modules(paths, root=root)
+    by_path = {m.path: m for m in modules}
+    findings: List[Finding] = []
+    for rule in rules:
+        if rule.scope == "file":
+            for module in modules:
+                findings.extend(rule.check_module(module))
+        else:
+            findings.extend(rule.check_project(modules, Path(config_dir) if config_dir else None))
+    findings = [f for f in findings if not (f.path in by_path and by_path[f.path].suppressed(f))]
+    if baseline:
+        findings = filter_baseline(findings, baseline)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

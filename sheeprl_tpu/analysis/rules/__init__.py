@@ -1,0 +1,49 @@
+"""jaxlint rule catalogue.
+
+| ID    | name                   | catches                                             |
+|-------|------------------------|-----------------------------------------------------|
+| JL001 | prng-key-reuse         | same PRNG key consumed twice without a split        |
+| JL002 | traced-control-flow    | python if/while/bool() on a traced value            |
+| JL003 | host-sync-in-hot-loop  | .item()/float()/np.asarray on device arrays in loops|
+| JL004 | recompile-hazard       | jit-in-loop, varying/unhashable static args,        |
+|       |                        | jitted closures over mutable state                  |
+| JL005 | use-after-donation     | reads of a buffer after donate_argnums donated it   |
+| JL006 | config-drift           | cfg keys accessed-but-undefined / defined-but-dead  |
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from sheeprl_tpu.analysis.engine import Rule
+from sheeprl_tpu.analysis.rules.jl001_prng import PRNGKeyReuse
+from sheeprl_tpu.analysis.rules.jl002_traced_control_flow import TracedControlFlow
+from sheeprl_tpu.analysis.rules.jl003_host_sync import HostSyncInHotLoop
+from sheeprl_tpu.analysis.rules.jl004_recompile import RecompileHazard
+from sheeprl_tpu.analysis.rules.jl005_donation import UseAfterDonation
+from sheeprl_tpu.analysis.rules.jl006_config_drift import ConfigDrift
+
+_RULE_CLASSES = [PRNGKeyReuse, TracedControlFlow, HostSyncInHotLoop, RecompileHazard, UseAfterDonation, ConfigDrift]
+
+
+def default_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instantiate the rule set, optionally restricted to the given rule ids."""
+    rules = [cls() for cls in _RULE_CLASSES]
+    if select:
+        wanted = {s.strip().upper() for s in select}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}; known: {[r.id for r in rules]}")
+        rules = [r for r in rules if r.id in wanted]
+    return rules
+
+
+__all__ = [
+    "default_rules",
+    "PRNGKeyReuse",
+    "TracedControlFlow",
+    "HostSyncInHotLoop",
+    "RecompileHazard",
+    "UseAfterDonation",
+    "ConfigDrift",
+]

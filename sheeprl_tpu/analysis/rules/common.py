@@ -1,0 +1,306 @@
+"""Shared AST machinery for the jaxlint rules.
+
+Everything here is *heuristic but sound in practice*: we resolve import aliases to
+canonical dotted paths (``jrandom.split`` -> ``jax.random.split``), walk function
+scopes without descending into nested function bodies (each nested function is its own
+scope), and propagate "this name is a jitted callable" facts through the simple
+assignment patterns the codebase actually uses (decorated defs, ``f = jax.jit(g)``,
+``self.f = f``, ``f = obj.f`` and tuple versions thereof).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+#: transforms whose function argument is traced (python control flow on its
+#: arguments is a concretization error)
+TRACING_TRANSFORMS = {
+    "jax.jit",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.scan",
+    "jax.lax.cond",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+}
+
+JIT_WRAPPERS = {"jax.jit", "jax.pmap"}
+
+#: attribute accesses on a traced value that yield *static* (trace-time) information
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "weak_type", "sharding", "itemsize"}
+
+#: calls that return static information regardless of argument taint
+STATIC_CALLS = {"len", "isinstance", "type", "jax.numpy.shape", "jax.numpy.ndim", "numpy.shape", "numpy.ndim"}
+
+
+# ------------------------------------------------------------------ import aliases
+def collect_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> canonical dotted path, from every import in the module."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def qualname(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted path of a Name/Attribute chain, or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = aliases.get(node.id, node.id)
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+def call_qualname(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    return qualname(call.func, aliases)
+
+
+# ------------------------------------------------------------------------- scopes
+@dataclass
+class Scope:
+    """One function (or the module) and its immediate body, nested scopes excluded."""
+
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda / Module
+    parent: Optional["Scope"]
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>" if isinstance(self.node, ast.Lambda) else "<module>")
+
+    def body(self) -> List[ast.stmt]:
+        if isinstance(self.node, ast.Lambda):
+            return [ast.Expr(self.node.body)]
+        return list(self.node.body)
+
+    def params(self) -> List[str]:
+        if not isinstance(self.node, FunctionNode):
+            return []
+        a = self.node.args
+        names = [x.arg for x in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+def iter_scopes(tree: ast.AST) -> Iterator[Scope]:
+    """Yield the module scope and every function scope, with parent links."""
+
+    def rec(node: ast.AST, parent: Optional[Scope]) -> Iterator[Scope]:
+        scope = Scope(node, parent)
+        yield scope
+        for child in walk_scope(node):
+            if isinstance(child, FunctionNode):
+                yield from rec(child, scope)
+
+    yield from rec(tree, None)
+
+
+def walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk limited to the current scope: does not descend into nested functions
+    (their *bodies*; decorators and defaults belong to the enclosing scope)."""
+    stack: List[ast.AST] = list(node) if isinstance(node, list) else list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, FunctionNode):
+            for dec in getattr(n, "decorator_list", []):
+                stack.append(dec)
+            continue  # nested scope: skip the body
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def walk_stmts_scope(stmts: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    for stmt in stmts:
+        yield stmt
+        if isinstance(stmt, FunctionNode):
+            continue  # nested scope: its body belongs to its own Scope
+        yield from walk_scope(stmt)
+
+
+def target_names(target: ast.AST) -> Iterator[str]:
+    """Plain names bound by an assignment target (tuples/lists/starred unpacked)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            yield from target_names(el)
+    elif isinstance(target, ast.Starred):
+        yield from target_names(target.value)
+
+
+def stmt_assigned_names(node: ast.AST) -> Set[str]:
+    """Every plain name (re)bound anywhere inside ``node`` (current scope only)."""
+    out: Set[str] = set()
+    for n in walk_scope(node) if not isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)) else [node]:
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                out.update(target_names(t))
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            out.update(target_names(n.target))
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            out.update(target_names(n.target))
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            out.update(target_names(n.optional_vars))
+        elif isinstance(n, ast.NamedExpr):
+            out.update(target_names(n.target))
+        elif isinstance(n, FunctionNode) and hasattr(n, "name"):
+            out.add(n.name)  # a def rebinds its name
+    return out
+
+
+# --------------------------------------------------------------- jit-ness tracking
+def _jit_call_info(call: ast.Call, aliases: Dict[str, str]) -> Optional[Dict[str, tuple]]:
+    """If ``call`` is ``jax.jit(...)`` (or ``partial(jax.jit, ...)``), return its
+    static/donate argument spec; else None."""
+    qn = call_qualname(call, aliases)
+    if qn in ("functools.partial", "partial") and call.args:
+        inner = call.args[0]
+        if qualname(inner, aliases) in JIT_WRAPPERS:
+            return _extract_jit_kwargs(call)
+        return None
+    if qn in JIT_WRAPPERS:
+        return _extract_jit_kwargs(call)
+    return None
+
+
+def _literal_tuple(node: ast.AST) -> tuple:
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return ()
+    if isinstance(v, (int, str)):
+        return (v,)
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return ()
+
+
+def _extract_jit_kwargs(call: ast.Call) -> Dict[str, tuple]:
+    spec = {"static_argnums": (), "static_argnames": (), "donate_argnums": (), "donate_argnames": ()}
+    for kw in call.keywords:
+        if kw.arg in spec:
+            spec[kw.arg] = _literal_tuple(kw.value)
+    return spec
+
+
+@dataclass
+class JitIndex:
+    """Which names/attributes in a module are jitted callables, plus their
+    static/donate specs.  Built with a small fixpoint over simple assignments."""
+
+    names: Set[str] = field(default_factory=set)
+    attrs: Set[str] = field(default_factory=set)
+    specs: Dict[str, Dict[str, tuple]] = field(default_factory=dict)  # name -> jit kwargs
+
+    def is_jitted_callee(self, func: ast.AST) -> Optional[str]:
+        """Return a display name if ``func`` (a Call.func node) is a known jitted
+        callable: a known Name, or any attribute access with a known jitted attr."""
+        if isinstance(func, ast.Name) and func.id in self.names:
+            return func.id
+        if isinstance(func, ast.Attribute) and func.attr in self.attrs:
+            return func.attr
+        return None
+
+
+def build_jit_index(tree: ast.AST, aliases: Dict[str, str]) -> JitIndex:
+    idx = JitIndex()
+    # Decorated defs (any nesting).
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    spec = _jit_call_info(dec, aliases)
+                    if spec is not None:
+                        idx.names.add(node.name)
+                        idx.specs[node.name] = spec
+                elif qualname(dec, aliases) in JIT_WRAPPERS:
+                    idx.names.add(node.name)
+    # Fixpoint over assignments: f = jax.jit(g); self.f = f; f = obj.f; tuples.
+    for _ in range(3):
+        before = (len(idx.names), len(idx.attrs))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                pairs: List[Tuple[ast.AST, ast.AST]] = []
+                if isinstance(target, (ast.Tuple, ast.List)) and isinstance(node.value, (ast.Tuple, ast.List)):
+                    if len(target.elts) == len(node.value.elts):
+                        pairs = list(zip(target.elts, node.value.elts))
+                else:
+                    pairs = [(target, node.value)]
+                for tgt, val in pairs:
+                    jitted = False
+                    spec = None
+                    if isinstance(val, ast.Call):
+                        spec = _jit_call_info(val, aliases)
+                        jitted = spec is not None
+                    elif isinstance(val, ast.Name) and val.id in idx.names:
+                        jitted, spec = True, idx.specs.get(val.id)
+                    elif isinstance(val, ast.Attribute) and val.attr in idx.attrs:
+                        jitted, spec = True, idx.specs.get(val.attr)
+                    if not jitted:
+                        continue
+                    if isinstance(tgt, ast.Name):
+                        idx.names.add(tgt.id)
+                        if spec:
+                            idx.specs[tgt.id] = spec
+                    elif isinstance(tgt, ast.Attribute):
+                        idx.attrs.add(tgt.attr)
+                        if spec:
+                            idx.specs[tgt.attr] = spec
+        if (len(idx.names), len(idx.attrs)) == before:
+            break
+    return idx
+
+
+# ------------------------------------------------------------------ taint helpers
+def expr_tainted(node: ast.AST, tainted: Set[str], aliases: Dict[str, str]) -> bool:
+    """Does evaluating ``node`` depend on the *value* (not just static metadata) of a
+    tainted name?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return expr_tainted(node.value, tainted, aliases)
+    if isinstance(node, ast.Call):
+        qn = call_qualname(node, aliases)
+        if qn in STATIC_CALLS:
+            return False
+        args: Iterable[ast.AST] = [*node.args, *[kw.value for kw in node.keywords]]
+        return any(expr_tainted(a, tainted, aliases) for a in args)
+    if isinstance(node, FunctionNode):
+        return False
+    return any(expr_tainted(child, tainted, aliases) for child in ast.iter_child_nodes(node))
+
+
+def enclosing_loops(scope_body: Sequence[ast.stmt]) -> List[Tuple[ast.AST, List[ast.AST]]]:
+    """Every for/while loop in a scope with the list of nodes inside it (scope-local)."""
+    out = []
+    for node in walk_stmts_scope(scope_body):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            inner = list(walk_stmts_scope(node.body + node.orelse))
+            out.append((node, inner))
+    return out

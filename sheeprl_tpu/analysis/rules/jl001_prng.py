@@ -1,0 +1,168 @@
+"""JL001 — PRNG key reuse.
+
+A JAX PRNG key is single-use: consuming the same key in two ``jax.random.*`` calls
+(samplers *or* ``split``) without re-deriving it in between silently correlates the
+two draws.  We flag, per function scope:
+
+* a key name consumed twice in statement order with no intervening rebind;
+* a key consumed inside a loop body whose name is never rebound in that loop
+  (every iteration re-consumes the same key).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from sheeprl_tpu.analysis.engine import Finding, Module, Rule
+from sheeprl_tpu.analysis.rules.common import (
+    Scope,
+    collect_aliases,
+    call_qualname,
+    enclosing_loops,
+    iter_scopes,
+    stmt_assigned_names,
+    target_names,
+    walk_scope,
+)
+
+_NON_CONSUMING = {"PRNGKey", "key", "key_data", "wrap_key_data", "key_impl"}
+
+
+def _terminates(stmts) -> bool:
+    """Does this branch always leave the enclosing block (return/raise/break/continue)?"""
+    return bool(stmts) and isinstance(stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _consumed_key_name(call: ast.Call, aliases) -> str | None:
+    """Name of the key variable this jax.random call consumes, if statically known."""
+    qn = call_qualname(call, aliases)
+    if not qn or not qn.startswith("jax.random."):
+        return None
+    fn = qn.rsplit(".", 1)[-1]
+    if fn in _NON_CONSUMING:
+        return None
+    key_arg = call.args[0] if call.args else None
+    if key_arg is None:
+        for kw in call.keywords:
+            if kw.arg == "key":
+                key_arg = kw.value
+    return key_arg.id if isinstance(key_arg, ast.Name) else None
+
+
+class PRNGKeyReuse(Rule):
+    id = "JL001"
+    name = "prng-key-reuse"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        aliases = collect_aliases(module.tree)
+        findings: List[Finding] = []
+        for scope in iter_scopes(module.tree):
+            findings.extend(self._check_scope(module, scope, aliases))
+        return findings
+
+    # ------------------------------------------------------------- linear scan
+    def _check_scope(self, module: Module, scope: Scope, aliases) -> List[Finding]:
+        findings: List[Finding] = []
+        consumed: Dict[str, int] = {}  # key name -> line of first consumption
+        flagged: set = set()
+
+        def flag(name: str, node: ast.AST, why: str) -> None:
+            key = (name, node.lineno)
+            if key in flagged:
+                return
+            flagged.add(key)
+            findings.append(
+                Finding(
+                    rule=self.id,
+                    path=module.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"PRNG key '{name}' {why}; split it (e.g. "
+                    f"'{name}, subkey = jax.random.split({name})') before reuse",
+                    detail=f"{scope.name}:{name}",
+                )
+            )
+
+        def handle_expr(node: ast.AST) -> None:
+            for n in walk_scope(node) if not isinstance(node, ast.Call) else [node, *walk_scope(node)]:
+                if isinstance(n, ast.Call):
+                    name = _consumed_key_name(n, aliases)
+                    if name is None:
+                        continue
+                    if name in consumed:
+                        flag(name, n, f"already consumed at line {consumed[name]} with no intervening split/rebind")
+                    else:
+                        consumed[name] = n.lineno
+
+        def handle_stmt(stmt: ast.stmt) -> None:
+            if isinstance(stmt, ast.Assign):
+                handle_expr(stmt.value)
+                for t in stmt.targets:
+                    for name in target_names(t):
+                        consumed.pop(name, None)
+                return
+            if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    handle_expr(stmt.value)
+                for name in target_names(stmt.target):
+                    consumed.pop(name, None)
+                return
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                handle_expr(stmt.iter)
+                for name in target_names(stmt.target):
+                    consumed.pop(name, None)
+                saved = dict(consumed)
+                for s in stmt.body + stmt.orelse:
+                    handle_stmt(s)
+                # conservative join: a rebind inside the loop may or may not run
+                for k, v in saved.items():
+                    consumed.setdefault(k, v)
+                return
+            if isinstance(stmt, (ast.If, ast.While)):
+                # Branches are exclusive: process each from the same base state, then
+                # join (union of consumptions from branches that can fall through).
+                handle_expr(stmt.test)
+                base = dict(consumed)
+                for s in stmt.body:
+                    handle_stmt(s)
+                body_out = dict(consumed)
+                consumed.clear()
+                consumed.update(base)
+                for s in stmt.orelse:
+                    handle_stmt(s)
+                orelse_out = dict(consumed)
+                consumed.clear()
+                consumed.update(base)
+                if not _terminates(stmt.body):
+                    for k, v in body_out.items():
+                        consumed.setdefault(k, v)
+                if not _terminates(stmt.orelse):
+                    for k, v in orelse_out.items():
+                        consumed.setdefault(k, v)
+                return
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    handle_expr(item.context_expr)
+                for s in stmt.body:
+                    handle_stmt(s)
+                return
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                return  # nested scopes are checked separately
+            for child in ast.iter_child_nodes(stmt):
+                handle_expr(child)
+
+        for stmt in scope.body():
+            handle_stmt(stmt)
+
+        # ------------------------------------------------- loop-carried reuse
+        for loop, inner in enclosing_loops(scope.body()):
+            rebound = set()
+            for n in inner:
+                rebound |= stmt_assigned_names(n) if isinstance(n, ast.stmt) else set()
+            for n in inner:
+                if isinstance(n, ast.Call):
+                    name = _consumed_key_name(n, aliases)
+                    if name is not None and name not in rebound:
+                        flag(name, n, "is consumed every loop iteration but never rebound in the loop")
+        return findings

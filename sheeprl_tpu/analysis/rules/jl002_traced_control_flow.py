@@ -1,0 +1,150 @@
+"""JL002 — Python control flow on traced values.
+
+Inside a function that JAX traces (``@jax.jit``, an argument to ``jax.lax.scan`` /
+``cond`` / ``while_loop`` / ``fori_loop``, ``vmap``, ``grad``, ...), a Python ``if`` /
+``while`` / ternary / short-circuit ``and``/``or`` / ``bool()`` on a traced value
+raises ``TracerBoolConversionError`` at best and silently bakes in a constant at
+worst.  Taint starts at the traced function's parameters and propagates through
+assignments; static metadata (``x.shape``, ``x.dtype``, ``len(x)``...) is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from sheeprl_tpu.analysis.engine import Finding, Module, Rule
+from sheeprl_tpu.analysis.rules.common import (
+    FunctionNode,
+    Scope,
+    TRACING_TRANSFORMS,
+    collect_aliases,
+    call_qualname,
+    expr_tainted,
+    iter_scopes,
+    qualname,
+    target_names,
+    walk_scope,
+)
+
+
+def _traced_function_nodes(tree: ast.AST, aliases) -> Set[ast.AST]:
+    """Function nodes whose bodies run under a JAX trace."""
+    traced: Set[ast.AST] = set()
+    defs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                qn = qualname(target, aliases)
+                if qn in TRACING_TRANSFORMS:
+                    traced.add(node)
+                elif qn in ("functools.partial", "partial") and isinstance(dec, ast.Call) and dec.args:
+                    if qualname(dec.args[0], aliases) in TRACING_TRANSFORMS:
+                        traced.add(node)
+        elif isinstance(node, ast.Call):
+            qn = call_qualname(node, aliases)
+            if qn not in TRACING_TRANSFORMS:
+                continue
+            for arg in [*node.args, *[kw.value for kw in node.keywords]]:
+                if isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+                elif isinstance(arg, ast.Name) and arg.id in defs_by_name:
+                    traced.add(defs_by_name[arg.id])
+    return traced
+
+
+class TracedControlFlow(Rule):
+    id = "JL002"
+    name = "traced-control-flow"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        aliases = collect_aliases(module.tree)
+        traced = _traced_function_nodes(module.tree, aliases)
+        findings: List[Finding] = []
+        for scope in iter_scopes(module.tree):
+            if scope.node in traced:
+                findings.extend(self._check_traced_scope(module, scope, aliases))
+        return findings
+
+    def _check_traced_scope(self, module: Module, scope: Scope, aliases) -> List[Finding]:
+        findings: List[Finding] = []
+        tainted: Set[str] = set(scope.params())
+        seen_lines: Set[tuple] = set()
+
+        def flag(node: ast.AST, construct: str) -> None:
+            key = (node.lineno, construct)
+            if key in seen_lines:
+                return
+            seen_lines.add(key)
+            findings.append(
+                Finding(
+                    rule=self.id,
+                    path=module.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"Python {construct} on a traced value inside traced function "
+                    f"'{scope.name}'; use jax.lax.cond/select/while_loop or jnp.where instead",
+                    detail=f"{scope.name}:{construct}",
+                )
+            )
+
+        def check_expr(node: ast.AST) -> None:
+            for n in [node, *walk_scope(node)]:
+                if isinstance(n, ast.BoolOp) and expr_tainted(n, tainted, aliases):
+                    flag(n, "and/or" if isinstance(n.op, ast.And) or isinstance(n.op, ast.Or) else "boolop")
+                elif isinstance(n, ast.IfExp) and expr_tainted(n.test, tainted, aliases):
+                    flag(n, "ternary")
+                elif (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == "bool"
+                    and n.args
+                    and expr_tainted(n.args[0], tainted, aliases)
+                ):
+                    flag(n, "bool()")
+
+        def handle_stmt(stmt: ast.stmt) -> None:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                return  # nested scope: traced nested functions are checked on their own
+            if isinstance(stmt, ast.If):
+                if expr_tainted(stmt.test, tainted, aliases):
+                    flag(stmt, "if")
+                check_expr(stmt.test)
+                for s in stmt.body + stmt.orelse:
+                    handle_stmt(s)
+                return
+            if isinstance(stmt, ast.While):
+                if expr_tainted(stmt.test, tainted, aliases):
+                    flag(stmt, "while")
+                check_expr(stmt.test)
+                for s in stmt.body + stmt.orelse:
+                    handle_stmt(s)
+                return
+            if isinstance(stmt, ast.Assign):
+                check_expr(stmt.value)
+                if expr_tainted(stmt.value, tainted, aliases):
+                    for t in stmt.targets:
+                        tainted.update(target_names(t))
+                else:
+                    for t in stmt.targets:
+                        for name in target_names(t):
+                            tainted.discard(name)
+                return
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                check_expr(stmt.iter)
+                if expr_tainted(stmt.iter, tainted, aliases):
+                    tainted.update(target_names(stmt.target))
+                for s in stmt.body + stmt.orelse:
+                    handle_stmt(s)
+                return
+            for child in ast.iter_child_nodes(stmt):
+                if not isinstance(child, FunctionNode):
+                    check_expr(child)
+
+        for stmt in scope.body():
+            handle_stmt(stmt)
+        return findings

@@ -1,0 +1,147 @@
+"""JL003 — implicit host syncs in hot loops.
+
+``x.item()``, ``float(x)``, ``int(x)``, ``bool(x)``, ``np.asarray(x)`` / ``np.array(x)``
+on a device array block the host until the device catches up; inside a per-step
+training loop that stalls the dispatch pipeline every iteration.  A value is
+"device-tainted" when it flows from a call to a known-jitted callable (see
+``common.build_jit_index``), from ``jax.device_put``, or from a ``jax.numpy`` op;
+``jax.device_get`` / ``jax.block_until_ready`` are *explicit* syncs and clear the
+taint (one deliberate sync beats many hidden ones).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from sheeprl_tpu.analysis.engine import Finding, Module, Rule
+from sheeprl_tpu.analysis.rules.common import (
+    FunctionNode,
+    Scope,
+    build_jit_index,
+    collect_aliases,
+    call_qualname,
+    iter_scopes,
+    target_names,
+    walk_scope,
+)
+
+_EXPLICIT_SYNCS = {"jax.device_get", "jax.block_until_ready", "numpy.asarray", "numpy.array"}
+_SYNC_BUILTINS = {"float", "int", "bool"}
+_NP_SYNC_CALLS = {"numpy.asarray", "numpy.array", "numpy.float32", "numpy.float64", "numpy.int32", "numpy.int64"}
+
+
+class HostSyncInHotLoop(Rule):
+    id = "JL003"
+    name = "host-sync-in-hot-loop"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        aliases = collect_aliases(module.tree)
+        jit_index = build_jit_index(module.tree, aliases)
+        findings: List[Finding] = []
+        for scope in iter_scopes(module.tree):
+            findings.extend(self._check_scope(module, scope, aliases, jit_index))
+        return findings
+
+    def _check_scope(self, module: Module, scope: Scope, aliases, jit_index) -> List[Finding]:
+        findings: List[Finding] = []
+        device: Set[str] = set()
+        seen: Set[tuple] = set()
+
+        def device_producing(node: ast.AST) -> bool:
+            """Does this expression yield a device value?"""
+            if isinstance(node, ast.Name):
+                return node.id in device
+            if isinstance(node, ast.Call):
+                qn = call_qualname(node, aliases)
+                if qn in _EXPLICIT_SYNCS:
+                    return False
+                if qn is not None and (qn.startswith("jax.numpy.") or qn == "jax.device_put"):
+                    return True
+                if jit_index.is_jitted_callee(node.func):
+                    return True
+                if isinstance(node.func, ast.Attribute):
+                    # method call: taint follows the receiver (env.step(device_action)
+                    # returns host values; device_array.sum() stays on device)
+                    return device_producing(node.func.value)
+                return any(device_producing(a) for a in [*node.args, *[kw.value for kw in node.keywords]])
+            if isinstance(node, FunctionNode):
+                return False
+            return any(device_producing(c) for c in ast.iter_child_nodes(node))
+
+        def flag(node: ast.AST, call_desc: str) -> None:
+            key = (node.lineno, call_desc)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(
+                Finding(
+                    rule=self.id,
+                    path=module.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"implicit host sync '{call_desc}' on a device array inside a hot loop; "
+                    "batch the transfer with one jax.device_get outside the step, or keep the "
+                    "value on device",
+                    detail=f"{scope.name}:{call_desc}",
+                )
+            )
+
+        def check_sync_calls(node: ast.AST, in_loop: bool) -> None:
+            for n in [node, *walk_scope(node)]:
+                if not isinstance(n, ast.Call) or not in_loop:
+                    continue
+                qn = call_qualname(n, aliases)
+                arg0 = n.args[0] if n.args else None
+                if isinstance(n.func, ast.Attribute) and n.func.attr == "item" and device_producing(n.func.value):
+                    flag(n, ".item()")
+                elif (
+                    isinstance(n.func, ast.Name)
+                    and n.func.id in _SYNC_BUILTINS
+                    and arg0 is not None
+                    and device_producing(arg0)
+                ):
+                    flag(n, f"{n.func.id}()")
+                elif qn in _NP_SYNC_CALLS and arg0 is not None and device_producing(arg0):
+                    flag(n, qn.replace("numpy.", "np."))
+
+        def handle_stmt(stmt: ast.stmt, in_loop: bool) -> None:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                return
+            if isinstance(stmt, ast.Assign):
+                check_sync_calls(stmt.value, in_loop)
+                produces = device_producing(stmt.value)
+                for t in stmt.targets:
+                    for name in target_names(t):
+                        (device.add if produces else device.discard)(name)
+                return
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                check_sync_calls(stmt.iter, in_loop)
+                if device_producing(stmt.iter):
+                    device.update(target_names(stmt.target))
+                for s in stmt.body + stmt.orelse:
+                    handle_stmt(s, True)
+                return
+            if isinstance(stmt, ast.While):
+                check_sync_calls(stmt.test, in_loop)
+                for s in stmt.body + stmt.orelse:
+                    handle_stmt(s, True)
+                return
+            if isinstance(stmt, (ast.If,)):
+                check_sync_calls(stmt.test, in_loop)
+                for s in stmt.body + stmt.orelse:
+                    handle_stmt(s, in_loop)
+                return
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    check_sync_calls(item.context_expr, in_loop)
+                for s in stmt.body:
+                    handle_stmt(s, in_loop)
+                return
+            for child in ast.iter_child_nodes(stmt):
+                if not isinstance(child, FunctionNode):
+                    check_sync_calls(child, in_loop)
+
+        for stmt in scope.body():
+            handle_stmt(stmt, False)
+        return findings

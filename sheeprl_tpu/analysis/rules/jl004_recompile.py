@@ -1,0 +1,198 @@
+"""JL004 — recompile hazards.
+
+Three statically detectable ways to turn a 30µs jit cache hit into a multi-second
+XLA compile every step:
+
+* **jit-in-loop** — applying ``jax.jit`` (directly, via ``partial``, or as a decorator
+  on a def) inside a ``for``/``while`` body creates a fresh cache each iteration;
+* **unhashable static arg** — a list/dict/set literal passed for a
+  ``static_argnums``/``static_argnames`` parameter (TypeError at best, recompile via
+  ``str()`` fallback in older JAX at worst);
+* **varying static arg** — a loop-varying name passed for a static parameter
+  recompiles on every new value;
+* **mutable closure** — a jitted nested function closing over a name the enclosing
+  scope reassigns *after* the definition: the trace bakes in the first value and the
+  update never reaches the compiled code (or, with explicit re-wrapping, recompiles).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.engine import Finding, Module, Rule
+from sheeprl_tpu.analysis.rules.common import (
+    FunctionNode,
+    Scope,
+    _jit_call_info,
+    build_jit_index,
+    collect_aliases,
+    enclosing_loops,
+    iter_scopes,
+    qualname,
+    stmt_assigned_names,
+    target_names,
+    walk_scope,
+)
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+class RecompileHazard(Rule):
+    id = "JL004"
+    name = "recompile-hazard"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        aliases = collect_aliases(module.tree)
+        jit_index = build_jit_index(module.tree, aliases)
+        findings: List[Finding] = []
+        for scope in iter_scopes(module.tree):
+            findings.extend(self._jit_in_loop(module, scope, aliases))
+            findings.extend(self._static_arg_hazards(module, scope, aliases, jit_index))
+        findings.extend(self._mutable_closures(module, aliases))
+        return findings
+
+    # ------------------------------------------------------------- jit-in-loop
+    def _jit_in_loop(self, module: Module, scope: Scope, aliases) -> List[Finding]:
+        findings: List[Finding] = []
+        for loop, inner in enclosing_loops(scope.body()):
+            for n in inner:
+                is_jit = isinstance(n, ast.Call) and _jit_call_info(n, aliases) is not None
+                if not is_jit and isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    is_jit = any(
+                        (isinstance(d, ast.Call) and _jit_call_info(d, aliases) is not None)
+                        or qualname(d, aliases) in ("jax.jit", "jax.pmap")
+                        for d in n.decorator_list
+                    )
+                if is_jit:
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=module.path,
+                            line=n.lineno,
+                            col=n.col_offset,
+                            message="jax.jit applied inside a loop: every iteration builds a fresh "
+                            "jit cache and recompiles; hoist the jit out of the loop",
+                            detail=f"{scope.name}:jit-in-loop",
+                        )
+                    )
+        return findings
+
+    # ------------------------------------------------------ static-arg hazards
+    def _static_arg_hazards(self, module: Module, scope: Scope, aliases, jit_index) -> List[Finding]:
+        findings: List[Finding] = []
+        loops = enclosing_loops(scope.body())
+        loop_varying: Dict[int, Set[str]] = {}
+        loop_members: List[Tuple[ast.AST, Set[int]]] = []
+        for loop, inner in loops:
+            names: Set[str] = set()
+            if isinstance(loop, (ast.For, ast.AsyncFor)):
+                names.update(target_names(loop.target))
+            for n in inner:
+                if isinstance(n, ast.stmt):
+                    names |= stmt_assigned_names(n)
+            loop_varying[id(loop)] = names
+            loop_members.append((loop, {id(x) for x in inner}))
+
+        for node in walk_scope(scope.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = jit_index.is_jitted_callee(node.func)
+            if callee is None:
+                continue
+            spec = jit_index.specs.get(callee)
+            if not spec:
+                continue
+            static_nums = {n for n in spec.get("static_argnums", ()) if isinstance(n, int)}
+            static_names = set(spec.get("static_argnames", ()))
+            if not static_nums and not static_names:
+                continue
+            in_loops = [loop for loop, members in loop_members if id(node) in members]
+            static_args = [(i, a) for i, a in enumerate(node.args) if i in static_nums]
+            static_args += [(kw.arg, kw.value) for kw in node.keywords if kw.arg in static_names]
+            for pos, arg in static_args:
+                if isinstance(arg, _UNHASHABLE):
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=module.path,
+                            line=arg.lineno,
+                            col=arg.col_offset,
+                            message=f"unhashable literal passed as static argument {pos!r} of jitted "
+                            f"'{callee}'; static args must be hashable (use a tuple)",
+                            detail=f"{scope.name}:{callee}:static-unhashable",
+                        )
+                    )
+                elif isinstance(arg, ast.Name) and any(
+                    arg.id in loop_varying.get(id(loop), ()) for loop in in_loops
+                ):
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=module.path,
+                            line=arg.lineno,
+                            col=arg.col_offset,
+                            message=f"loop-varying value '{arg.id}' passed as static argument {pos!r} of "
+                            f"jitted '{callee}': every new value recompiles; pass it traced or hoist it",
+                            detail=f"{scope.name}:{callee}:static-varying",
+                        )
+                    )
+        return findings
+
+    # --------------------------------------------------------- mutable closure
+    def _mutable_closures(self, module: Module, aliases) -> List[Finding]:
+        findings: List[Finding] = []
+        reported: Set[str] = set()
+        for scope in iter_scopes(module.tree):
+            if not isinstance(scope.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            enclosing = scope.parent
+            if enclosing is None or not isinstance(enclosing.node, FunctionNode):
+                continue
+            jitted = any(
+                (isinstance(d, ast.Call) and _jit_call_info(d, aliases) is not None)
+                or qualname(d, aliases) in ("jax.jit", "jax.pmap")
+                for d in scope.node.decorator_list
+            )
+            if not jitted:
+                continue
+            # free variables: names read in the nested fn, not bound locally
+            local = set(scope.params())
+            for stmt in scope.body():
+                local |= stmt_assigned_names(stmt)
+            reads: Set[str] = set()
+            for n in walk_scope(scope.node):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id not in local:
+                    reads.add(n.id)
+            # enclosing-scope rebinds after the def line (or inside a loop)
+            def_line = scope.node.lineno
+            for stmt in enclosing.body():
+                for n in [stmt, *walk_scope(stmt)]:
+                    if not isinstance(n, ast.stmt):
+                        continue
+                    assigned = stmt_assigned_names(n) & reads
+                    if not assigned:
+                        continue
+                    in_loop = any(
+                        id(n) in {id(x) for x in inner} for _, inner in enclosing_loops(enclosing.body())
+                    )
+                    if n.lineno > def_line or in_loop:
+                        for name in sorted(assigned):
+                            fp = f"{enclosing.name}:{scope.name}:closure:{name}"
+                            if fp in reported:
+                                continue
+                            reported.add(fp)
+                            findings.append(
+                                Finding(
+                                    rule=self.id,
+                                    path=module.path,
+                                    line=scope.node.lineno,
+                                    col=scope.node.col_offset,
+                                    message=f"jitted '{scope.name}' closes over '{name}', which "
+                                    f"'{enclosing.name}' reassigns at line {n.lineno}: the trace bakes "
+                                    "in the first value — pass it as an argument instead",
+                                    detail=f"{enclosing.name}:{scope.name}:closure:{name}",
+                                )
+                            )
+                        break
+        return findings

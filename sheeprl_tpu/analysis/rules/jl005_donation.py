@@ -1,0 +1,148 @@
+"""JL005 — donated-buffer use-after-donation.
+
+``jax.jit(f, donate_argnums=(0,))`` hands the argument's device buffer to XLA; any
+later read of the donated array raises ``RuntimeError: invalid buffer`` — but only on
+backends that actually donate (TPU/GPU), so CPU tests pass and the TPU run dies.  We
+track calls through known donating wrappers and flag reads of a donated name before
+it is rebound — including the implicit next-iteration read when the donating call
+sits in a loop that never rebinds the name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from sheeprl_tpu.analysis.engine import Finding, Module, Rule
+from sheeprl_tpu.analysis.rules.common import (
+    Scope,
+    build_jit_index,
+    collect_aliases,
+    enclosing_loops,
+    iter_scopes,
+    stmt_assigned_names,
+    target_names,
+    walk_scope,
+)
+
+
+def _donated_names(call: ast.Call, spec: Dict[str, tuple]) -> List[str]:
+    nums = {n for n in spec.get("donate_argnums", ()) if isinstance(n, int)}
+    names = set(spec.get("donate_argnames", ()))
+    out = []
+    for i, a in enumerate(call.args):
+        if i in nums and isinstance(a, ast.Name):
+            out.append(a.id)
+    for kw in call.keywords:
+        if kw.arg in names and isinstance(kw.value, ast.Name):
+            out.append(kw.value.id)
+    return out
+
+
+class UseAfterDonation(Rule):
+    id = "JL005"
+    name = "use-after-donation"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        aliases = collect_aliases(module.tree)
+        jit_index = build_jit_index(module.tree, aliases)
+        if not any(
+            any(spec.get("donate_argnums") or spec.get("donate_argnames") for spec in (jit_index.specs.get(n),) if spec)
+            for n in [*jit_index.names, *jit_index.attrs]
+        ):
+            return []
+        findings: List[Finding] = []
+        for scope in iter_scopes(module.tree):
+            findings.extend(self._check_scope(module, scope, aliases, jit_index))
+        return findings
+
+    def _donating_call(self, node: ast.AST, jit_index) -> List[str]:
+        if not isinstance(node, ast.Call):
+            return []
+        callee = jit_index.is_jitted_callee(node.func)
+        if callee is None:
+            return []
+        spec = jit_index.specs.get(callee)
+        if not spec or not (spec.get("donate_argnums") or spec.get("donate_argnames")):
+            return []
+        return _donated_names(node, spec)
+
+    def _check_scope(self, module: Module, scope: Scope, aliases, jit_index) -> List[Finding]:
+        findings: List[Finding] = []
+        donated: Dict[str, int] = {}  # name -> line of donation
+        seen: Set[tuple] = set()
+
+        def flag(name: str, node: ast.AST, why: str) -> None:
+            key = (name, node.lineno)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(
+                Finding(
+                    rule=self.id,
+                    path=module.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"'{name}' {why}: its device buffer is invalid after donation "
+                    "(fails on TPU/GPU even though CPU runs pass); rebind the result "
+                    f"(e.g. '{name} = f({name})') or drop the donation",
+                    detail=f"{scope.name}:{name}",
+                )
+            )
+
+        def handle_expr(node: ast.AST) -> None:
+            for n in [node, *walk_scope(node)]:
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id in donated:
+                    flag(n.id, n, f"is read after being donated at line {donated[n.id]}")
+            for n in [node, *walk_scope(node)]:
+                for name in self._donating_call(n, jit_index):
+                    donated[name] = n.lineno
+
+        def handle_stmt(stmt: ast.stmt) -> None:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                return
+            if isinstance(stmt, ast.Assign):
+                handle_expr(stmt.value)
+                for t in stmt.targets:
+                    for name in target_names(t):
+                        donated.pop(name, None)
+                return
+            if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    handle_expr(stmt.value)
+                for name in target_names(stmt.target):
+                    donated.pop(name, None)
+                return
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While, ast.If)):
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    handle_expr(stmt.iter)
+                    for name in target_names(stmt.target):
+                        donated.pop(name, None)
+                else:
+                    handle_expr(stmt.test)
+                for s in stmt.body + stmt.orelse:
+                    handle_stmt(s)
+                return
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    handle_expr(item.context_expr)
+                for s in stmt.body:
+                    handle_stmt(s)
+                return
+            for child in ast.iter_child_nodes(stmt):
+                handle_expr(child)
+
+        for stmt in scope.body():
+            handle_stmt(stmt)
+
+        # loop-carried: donating call in a loop that never rebinds the donated name
+        for loop, inner in enclosing_loops(scope.body()):
+            rebound: Set[str] = set()
+            for n in inner:
+                if isinstance(n, ast.stmt):
+                    rebound |= stmt_assigned_names(n)
+            for n in inner:
+                for name in self._donating_call(n, jit_index):
+                    if name not in rebound:
+                        flag(name, n, "is donated every loop iteration but never rebound in the loop")
+        return findings

@@ -1,0 +1,258 @@
+"""JL006 — Hydra config drift.
+
+Cross-checks every ``cfg.x.y`` / ``cfg.get("x")`` access in the linted Python files
+against the union of the YAML config tree (:mod:`sheeprl_tpu.analysis.config_index`):
+
+* **accessed-but-undefined** — the code reads a key no YAML file defines.  With a
+  ``.get(..., default)`` this fails *silently*: the hard-coded default shadows
+  whatever the YAML author believes the value is (or a typo'd key always returns the
+  default).  Reported at the access site.
+* **defined-but-never-accessed** — dead config: a YAML key no code path and no
+  ``${...}`` interpolation ever reads.  Reported at the YAML definition site.
+
+Accesses are resolved through attribute chains, literal ``.get``/``.pop``/``[...]``
+lookups, ``(cfg.get("x") or {})`` guards, and one level of call-site propagation:
+when ``f(cfg.a.b)`` passes a sub-config to a function whose parameter accesses
+``.lr`` / ``.get("eps")``, those count as accesses of ``a.b.lr`` / ``a.b.eps``.
+A dynamic access (non-literal key, iteration, ``**splat``) marks the whole subtree
+used.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from sheeprl_tpu.analysis.config_index import ConfigIndex, PathTuple, build_config_index
+from sheeprl_tpu.analysis.engine import Finding, Module, Rule
+from sheeprl_tpu.analysis.rules.common import FunctionNode
+
+_DICT_METHODS = {"get", "pop", "keys", "values", "items", "update", "setdefault", "copy", "clear", "to_dict"}
+_CFG_ROOTS = {"cfg"}
+
+#: root keys the CLI/runtime injects programmatically rather than via YAML
+_RUNTIME_KEYS = {("rank",), ("world_size",), ("checkpoint", "resume_from")}
+
+
+def _resolve(node: ast.AST, roots: Dict[str, PathTuple]) -> Optional[PathTuple]:
+    """Dotted config path of an expression rooted at one of ``roots`` (a map of
+    local name -> path prefix; the root config itself has prefix ()), or None."""
+    if isinstance(node, ast.Name):
+        return roots.get(node.id)
+    if isinstance(node, ast.Attribute):
+        base = _resolve(node.value, roots)
+        if base is None or node.attr in _DICT_METHODS:
+            return None
+        return base + (node.attr,)
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in ("get", "pop"):
+            base = _resolve(func.value, roots)
+            if base is not None and node.args and isinstance(node.args[0], ast.Constant):
+                key = node.args[0].value
+                if isinstance(key, str):
+                    return base + (key,)
+        if isinstance(func, ast.Name) and func.id == "dict" and len(node.args) == 1:
+            return _resolve(node.args[0], roots)  # dict(cfg.x) keeps the path
+        return None
+    if isinstance(node, ast.Subscript):
+        base = _resolve(node.value, roots)
+        if base is not None and isinstance(node.slice, ast.Constant) and isinstance(node.slice.value, str):
+            return base + (node.slice.value,)
+        return None
+    if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or) and node.values:
+        return _resolve(node.values[0], roots)
+    return None
+
+
+class _AccessCollector(ast.NodeVisitor):
+    """Records every maximal config-path access in a module (or function body).
+
+    Local aliases of sub-configs (``wm_cfg = cfg.algo.world_model``) become new
+    roots, so accesses through them resolve to full dotted paths."""
+
+    def __init__(self, roots: Dict[str, PathTuple]):
+        self.roots = dict(roots)
+        self.accessed: List[Tuple[PathTuple, int, int]] = []  # (path, line, col)
+        self.assigned: Set[PathTuple] = set()  # cfg.x = ... programmatic definitions
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        alias = _resolve(node.value, self.roots)
+        if alias and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            self.roots[node.targets[0].id] = alias
+        self.generic_visit(node)
+
+    def _try(self, node: ast.AST) -> bool:
+        path = _resolve(node, self.roots)
+        if path:
+            self.accessed.append((path, node.lineno, node.col_offset))
+            # keep walking non-path children (e.g. the default of .get(k, <expr>))
+            if isinstance(node, ast.Call):
+                for a in node.args[1:]:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+            return True
+        return False
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Store):
+            path = _resolve(node, self.roots)
+            if path:
+                self.assigned.add(path)
+                return
+        if not self._try(node):
+            self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if not self._try(node):
+            self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if not self._try(node):
+            self.generic_visit(node)
+
+
+def _param_accesses(tree: ast.AST) -> Dict[str, Dict[object, List[PathTuple]]]:
+    """function name -> {param position and name -> relative paths accessed on it}."""
+    out: Dict[str, Dict[object, List[PathTuple]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = [a.arg for a in (*node.args.posonlyargs, *node.args.args)]
+        per_param: Dict[object, List[PathTuple]] = {}
+        for i, p in enumerate(params):
+            if p in ("self", "cls") or p in _CFG_ROOTS:
+                continue
+            collector = _AccessCollector({p: ()})
+            for stmt in node.body:
+                collector.visit(stmt)
+            rels = [path for path, _, _ in collector.accessed if path]
+            if rels:
+                per_param[i] = rels
+                per_param[p] = rels
+        if per_param:
+            out.setdefault(node.name, {}).update(per_param)
+    return out
+
+
+class ConfigDrift(Rule):
+    id = "JL006"
+    name = "config-drift"
+    scope = "project"
+
+    def __init__(self, report_unused: bool = True):
+        self.report_unused = report_unused
+
+    def check_project(self, modules: Sequence[Module], config_dir: Optional[Path]) -> List[Finding]:
+        if config_dir is None:
+            config_dir = Path(__file__).resolve().parents[2] / "config" / "configs"
+        if not Path(config_dir).is_dir():
+            return []
+        repo_root = Path(config_dir).resolve()
+        for parent in repo_root.parents:
+            if (parent / "pyproject.toml").is_file() or (parent / ".git").exists():
+                repo_root = parent
+                break
+        else:
+            repo_root = Path.cwd()
+        index = build_config_index(Path(config_dir), root=repo_root)
+
+        accessed: Set[PathTuple] = set(index.interp_accessed)
+        assigned: Set[PathTuple] = set(_RUNTIME_KEYS)
+        sites: List[Tuple[Module, PathTuple, int, int]] = []
+
+        # pass 1: direct accesses + per-function param-relative accesses
+        param_maps: Dict[str, Dict[object, List[PathTuple]]] = {}
+        for module in modules:
+            for name, pmap in _param_accesses(module.tree).items():
+                param_maps.setdefault(name, {}).update(pmap)
+        for module in modules:
+            collector = _AccessCollector({r: () for r in _CFG_ROOTS})
+            collector.visit(module.tree)
+            assigned |= collector.assigned
+            for path, line, col in collector.accessed:
+                accessed.add(path)
+                sites.append((module, path, line, col))
+            # pass 2: call-site propagation through one level
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = None
+                if isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                pmap = param_maps.get(fname)
+                if not pmap:
+                    continue
+                bindings: List[Tuple[object, ast.AST]] = list(enumerate(node.args))
+                bindings += [(kw.arg, kw.value) for kw in node.keywords if kw.arg]
+                for key, arg in bindings:
+                    rels = pmap.get(key)
+                    if not rels:
+                        continue
+                    base = _resolve(arg, {r: () for r in _CFG_ROOTS})
+                    if not base:
+                        continue
+                    for rel in rels:
+                        accessed.add(base + rel)
+                        sites.append((module, base + rel, node.lineno, node.col_offset))
+
+        findings: List[Finding] = []
+        # ---------------------------------------------- accessed-but-undefined
+        seen_undefined: Set[Tuple[str, PathTuple]] = set()
+        for module, path, line, col in sites:
+            if path in index.defined or path in assigned:
+                continue
+            if any(path[: i + 1] in assigned for i in range(len(path))):
+                continue
+            prefix = index.longest_defined_prefix(path)
+            missing = path[: len(prefix) + 1]
+            key = (module.path, missing)
+            if key in seen_undefined:
+                continue
+            seen_undefined.add(key)
+            dotted = ".".join(path)
+            findings.append(
+                Finding(
+                    rule=self.id,
+                    path=module.path,
+                    line=line,
+                    col=col,
+                    message=f"config key '{dotted}' is accessed here but defined nowhere in the YAML "
+                    "tree: a .get default silently shadows the config (or the key is a typo); "
+                    "define it in YAML or drop the access",
+                    detail=f"undefined:{dotted}",
+                )
+            )
+
+        # ---------------------------------------------- defined-but-never-accessed
+        if self.report_unused:
+            used: Set[PathTuple] = set()
+            all_accessed = accessed | assigned
+            for d in index.defined:
+                for p in all_accessed:
+                    if p[: len(d)] == d or d[: len(p)] == p:
+                        used.add(d)
+                        break
+            for d, (yaml_rel, yaml_line) in sorted(index.defined.items()):
+                if d in used:
+                    continue
+                parent = d[:-1]
+                if parent and parent in index.defined and parent not in used:
+                    continue  # the subtree root is already reported; skip its children
+                dotted = ".".join(d)
+                findings.append(
+                    Finding(
+                        rule=self.id,
+                        path=yaml_rel,
+                        line=yaml_line,
+                        col=0,
+                        message=f"config key '{dotted}' is defined here but never accessed by any "
+                        "code path or ${...} interpolation: dead config (delete it, or wire it up)",
+                        detail=f"unused:{dotted}",
+                    )
+                )
+        return findings

@@ -1,0 +1,192 @@
+"""Runtime strict mode (``analysis.strict=True``).
+
+The static rules catch what is visible in the source; this module catches the rest at
+run time, while the run is still cheap to kill:
+
+* :func:`strict_guard` wraps a jitted entry point with a shape/dtype/structure guard:
+  the first call records the argument signature, any later drift (the thing that
+  silently recompiles) raises :class:`SignatureDriftError` instead;
+* :func:`nan_scan` is called *inside* a jitted function and emits a
+  ``jax.debug.callback`` that records non-finite outputs; :func:`assert_finite` /
+  :func:`raise_pending` turn those records into :class:`NonFiniteError` at the update
+  boundary, plus run a direct host-side scan over whatever tree they are given;
+* ``TrainingMonitor`` (``sheeprl_tpu/obs``) reads the same flag and upgrades the
+  recompile watchdog from a loud warning to a hard :class:`RecompileError`.
+
+Everything is a no-op (identity wrapper, early return) when strict mode is off, so
+the hot path pays nothing in normal runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Tuple
+
+_pending_lock = threading.Lock()
+_pending_nonfinite: List[str] = []
+
+#: name -> guarded callable, for introspection/tests
+_registered_guards: Dict[str, Callable] = {}
+
+
+class StrictModeError(RuntimeError):
+    """Base class for every hard failure strict mode introduces."""
+
+
+class SignatureDriftError(StrictModeError):
+    """A guarded jit entry point was called with a different signature than its
+    first call: the exact condition that triggers a silent recompile."""
+
+
+class NonFiniteError(StrictModeError):
+    """A NaN/Inf crossed the update boundary."""
+
+
+def strict_enabled(cfg: Any) -> bool:
+    """True iff ``cfg.analysis.strict`` is set (tolerates dicts/DotDicts/None)."""
+    if cfg is None:
+        return False
+    try:
+        analysis = cfg.get("analysis") if hasattr(cfg, "get") else getattr(cfg, "analysis", None)
+    except Exception:
+        return False
+    if not analysis:
+        return False
+    try:
+        return bool(analysis.get("strict", False) if hasattr(analysis, "get") else getattr(analysis, "strict", False))
+    except Exception:
+        return False
+
+
+# ----------------------------------------------------------------- signature guard
+def _leaf_signature(leaf: Any) -> Tuple:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None and dtype is None:
+        return (type(leaf).__name__,)
+    return (tuple(shape) if shape is not None else None, str(dtype))
+
+
+def _signature(args: tuple, kwargs: dict) -> Tuple:
+    import jax
+
+    leaves, treedef = jax.tree.flatten((args, kwargs))
+    return (str(treedef), tuple(_leaf_signature(leaf) for leaf in leaves))
+
+
+def strict_guard(cfg: Any, name: str, fn: Callable) -> Callable:
+    """Wrap a jitted entry point with a first-call signature guard.
+
+    Identity when strict mode is off.  The guard exists because a drifting argument
+    signature is invisible until the recompile hits the profile; with strict mode on
+    it fails at the call site with the offending leaf spelled out.
+    """
+    if not strict_enabled(cfg):
+        return fn
+
+    recorded: Dict[str, Tuple] = {}
+
+    def guarded(*args, **kwargs):
+        sig = _signature(args, kwargs)
+        first = recorded.get("sig")
+        if first is None:
+            recorded["sig"] = sig
+        elif sig != first:
+            diff = _describe_drift(first, sig)
+            raise SignatureDriftError(
+                f"analysis.strict: jit entry point '{name}' called with a drifting signature "
+                f"({diff}); this would silently recompile every time it changes. Pad/bucket the "
+                f"inputs to a fixed shape, or exempt this entry point from the guard."
+            )
+        return fn(*args, **kwargs)
+
+    guarded.__name__ = f"strict_guard[{name}]"
+    guarded.__wrapped__ = fn
+    _registered_guards[name] = guarded
+    return guarded
+
+
+def _describe_drift(first: Tuple, now: Tuple) -> str:
+    if first[0] != now[0]:
+        return f"tree structure changed: {first[0]} -> {now[0]}"
+    for i, (a, b) in enumerate(zip(first[1], now[1])):
+        if a != b:
+            return f"leaf {i}: {a} -> {b}"
+    return "argument count changed"
+
+
+def registered_guards() -> Dict[str, Callable]:
+    return dict(_registered_guards)
+
+
+# --------------------------------------------------------------------- NaN/Inf scan
+def nan_scan(tree: Any, label: str) -> None:
+    """Emit a non-finite check for every floating leaf of ``tree``.
+
+    Call *inside* a jitted function (guarded by a trace-time ``if strict:``); the
+    check runs as a ``jax.debug.callback``, so it costs one tiny host callback per
+    update and never blocks the device.  Pending hits are raised by
+    :func:`raise_pending` / :func:`assert_finite` at the next update boundary.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    paths, flags = [], []
+    for path, leaf in leaves_with_paths:
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.floating):
+            continue
+        paths.append(jax.tree_util.keystr(path))
+        flags.append(jnp.logical_not(jnp.all(jnp.isfinite(leaf))))
+    if not flags:
+        return
+
+    def _record(*flag_values):
+        hits = [p for p, f in zip(paths, flag_values) if bool(f)]
+        if hits:
+            with _pending_lock:
+                _pending_nonfinite.extend(f"{label}{p}" for p in hits)
+
+    jax.debug.callback(_record, *flags)
+
+
+def raise_pending() -> None:
+    """Raise :class:`NonFiniteError` if any ``nan_scan`` callback recorded a hit."""
+    import jax
+
+    try:
+        jax.effects_barrier()  # flush outstanding debug callbacks
+    except Exception:
+        pass
+    with _pending_lock:
+        hits, _pending_nonfinite[:] = list(_pending_nonfinite), []
+    if hits:
+        raise NonFiniteError(
+            f"analysis.strict: non-finite values crossed the update boundary: {sorted(set(hits))}"
+        )
+
+
+def clear_pending() -> None:
+    with _pending_lock:
+        _pending_nonfinite.clear()
+
+
+def assert_finite(cfg: Any, tree: Any, label: str) -> None:
+    """Update-boundary NaN/Inf scan: drains pending ``nan_scan`` hits, then checks
+    every floating leaf of ``tree`` host-side.  No-op unless strict mode is on."""
+    if not strict_enabled(cfg):
+        return
+    import numpy as np
+
+    raise_pending()
+    import jax
+
+    bad: List[str] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        if not np.isfinite(arr).all():
+            bad.append(f"{label}{jax.tree_util.keystr(path)}")
+    if bad:
+        raise NonFiniteError(f"analysis.strict: non-finite values at the update boundary: {bad}")

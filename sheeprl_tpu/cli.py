@@ -161,6 +161,12 @@ def run_algorithm(cfg: DotDict) -> None:
         from sheeprl_tpu.algos.p2e import load_exploration_config
 
         kwargs["exploration_cfg"] = load_exploration_config(cfg)
+    precision = cfg.get("float32_matmul_precision")
+    if precision:
+        # reference: torch.set_float32_matmul_precision(cfg.float32_matmul_precision)
+        import jax
+
+        jax.config.update("jax_default_matmul_precision", str(precision))
     maybe_init_distributed(cfg.get("mesh", {}))
     ctx = make_mesh_context(cfg)
 
@@ -181,7 +187,7 @@ def eval_algorithm(cfg: DotDict) -> None:
 
     ckpt_path = Path(cfg.checkpoint_path)
     if "capture_video" in cfg:  # top-level convenience alias for env.capture_video
-        cfg.env.capture_video = bool(cfg.capture_video)
+        cfg.env.capture_video = bool(cfg.capture_video)  # jaxlint: disable=JL006
     cfg.env.num_envs = 1
     cfg.run_name = cfg.get("run_name") or _default_run_name(cfg)
 

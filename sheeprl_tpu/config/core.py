@@ -437,7 +437,7 @@ def compose(
 
     # A mandatory group is satisfied when its key exists in the composed config
     # (whether via an explicit override or an exp file's defaults).
-    mandatory = set(cfg.pop("_mandatory_groups_", []))
+    mandatory = set(cfg.pop("_mandatory_groups_", []))  # jaxlint: disable=JL006 (internal sentinel)
     still_missing = {g for g in mandatory if g.split("/")[-1] not in cfg}
     if still_missing:
         raise ValueError(

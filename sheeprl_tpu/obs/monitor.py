@@ -31,7 +31,7 @@ from typing import Any, Dict, Optional
 from sheeprl_tpu.obs import tracer as _tracer
 from sheeprl_tpu.obs.telemetry import DeviceTelemetry
 from sheeprl_tpu.obs.tracer import SpanTracer
-from sheeprl_tpu.obs.watchdog import RecompileWarning, RecompileWatchdog
+from sheeprl_tpu.obs.watchdog import RecompileError, RecompileWarning, RecompileWatchdog
 
 _UPDATE_SPAN = "Time/update"
 _LOG_SPAN = "Time/log"
@@ -41,6 +41,11 @@ class TrainingMonitor:
     def __init__(self, cfg: Dict[str, Any], log_dir: str, rank: Optional[int] = None):
         obs_cfg = dict(cfg.get("obs", {}) or {})
         self.enabled: bool = bool(obs_cfg.get("enabled", False))
+        # analysis.strict upgrades the recompile watchdog from warning to hard error
+        # and arms NaN/Inf checks at the update boundary (sheeprl_tpu/analysis).
+        from sheeprl_tpu.analysis.strict import strict_enabled
+
+        self.strict: bool = strict_enabled(cfg)
         self.log_dir = log_dir
         self._updates = 0
         self._closed = False
@@ -93,6 +98,11 @@ class TrainingMonitor:
         """Call once at the top of every training update."""
         if not self.enabled:
             return
+        if self.strict:
+            # update boundary: surface any NaN/Inf the in-jit nan_scan callbacks saw
+            from sheeprl_tpu.analysis.strict import raise_pending
+
+            raise_pending()
         self._updates += 1
         update = self._updates
 
@@ -129,15 +139,16 @@ class TrainingMonitor:
             elif update > self._warmup_updates + 1:
                 n = self._watchdog.poll_new()
                 if n:
-                    warnings.warn(
+                    msg = (
                         f"{n} post-warmup XLA recompilation(s) detected at update {update - 1} "
                         f"(total={self._watchdog.total_compiles}): a jitted function's input "
                         "shapes/dtypes or captured constants are changing between updates, which "
                         "silently destroys throughput. Check Compile/recompiles and capture an "
-                        "XProf window (obs.capture_steps) around this update.",
-                        RecompileWarning,
-                        stacklevel=2,
+                        "XProf window (obs.capture_steps) around this update."
                     )
+                    if self.strict:
+                        raise RecompileError(f"analysis.strict: {msg}")
+                    warnings.warn(msg, RecompileWarning, stacklevel=2)
 
         if self._telemetry is not None:
             polled = self._telemetry.poll()

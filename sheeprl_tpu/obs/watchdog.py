@@ -20,6 +20,11 @@ class RecompileWarning(UserWarning):
     """Raised (via ``warnings.warn``) when a jitted function recompiles after warmup."""
 
 
+class RecompileError(RuntimeError):
+    """Hard-error form of :class:`RecompileWarning`, raised instead of warning when
+    runtime strict mode (``analysis.strict=True``) is enabled."""
+
+
 class RecompileWatchdog:
     def __init__(self):
         self._lock = threading.Lock()
@@ -71,9 +76,21 @@ class RecompileWatchdog:
 
     def close(self) -> None:
         self._active = False
-        try:  # private in jax 0.4.x; the _active flag already neutralises the listener
-            from jax._src import monitoring as _m
+        # Best-effort listener removal through whatever the installed JAX exposes
+        # publicly; no private jax._src import, so a JAX upgrade can only degrade
+        # this to the no-op fallback (the _active flag already neutralises the
+        # listener either way).
+        try:
+            from jax import monitoring as _m
 
-            _m._unregister_event_duration_listener_by_callback(self._listener)
+            for name in (
+                "unregister_event_duration_secs_listener",
+                "unregister_event_duration_listener_by_callback",
+                "_unregister_event_duration_listener_by_callback",
+            ):
+                unregister = getattr(_m, name, None)
+                if callable(unregister):
+                    unregister(self._listener)
+                    break
         except Exception:
             pass

@@ -30,12 +30,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _distributed_initialized = False
 
 
-def maybe_init_distributed(cfg: Dict[str, Any]) -> None:
+def maybe_init_distributed(mesh_cfg: Dict[str, Any]) -> None:
     """Initialise multi-host JAX when requested (replaces Fabric ``num_nodes``).
-    Idempotent: ``jax.distributed.initialize`` may only run once per process, and
-    multirun sweeps call this once per job."""
+    Takes the ``mesh`` sub-config (not the root config).  Idempotent:
+    ``jax.distributed.initialize`` may only run once per process, and multirun
+    sweeps call this once per job."""
     global _distributed_initialized
-    dist = cfg.get("distributed", {}) or {}
+    dist = mesh_cfg.get("distributed", {}) or {}
     if dist.get("coordinator_address") and not _distributed_initialized:
         jax.distributed.initialize(
             coordinator_address=dist["coordinator_address"],

@@ -1,0 +1,31 @@
+"""Helpers for the jaxlint tests: write a snippet to disk, lint it, return findings."""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+from typing import List, Optional
+
+import pytest
+
+from sheeprl_tpu.analysis.engine import Finding, run_lint
+from sheeprl_tpu.analysis.rules import default_rules
+
+
+@pytest.fixture()
+def lint(tmp_path):
+    """lint(source, select=[...]) -> findings for a single in-memory module."""
+
+    def _lint(source: str, select: Optional[List[str]] = None, config_dir=None) -> List[Finding]:
+        mod = tmp_path / "snippet.py"
+        mod.write_text(textwrap.dedent(source))
+        rules = default_rules(select) if select else default_rules(
+            ["JL001", "JL002", "JL003", "JL004", "JL005"]  # JL006 needs a config tree
+        )
+        return run_lint([mod], rules=rules, config_dir=config_dir, root=tmp_path)
+
+    return _lint
+
+
+def rule_ids(findings: List[Finding]) -> List[str]:
+    return [f.rule for f in findings]

@@ -1,0 +1,94 @@
+"""Engine mechanics: suppression comments, baseline round trip, CLI exit codes."""
+
+import textwrap
+
+from sheeprl_tpu.analysis.engine import (
+    Finding,
+    filter_baseline,
+    load_baseline,
+    parse_suppressions,
+    run_lint,
+    write_baseline,
+)
+from sheeprl_tpu.analysis.rules import default_rules
+
+_REUSE = """
+import jax
+
+def f(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,)){suffix}
+    return a + b
+"""
+
+
+def _lint_file(tmp_path, source, **kwargs):
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent(source))
+    return run_lint([mod], rules=default_rules(["JL001"]), root=tmp_path, **kwargs)
+
+
+def test_same_line_suppression(tmp_path):
+    assert _lint_file(tmp_path, _REUSE.format(suffix="")) != []
+    assert _lint_file(tmp_path, _REUSE.format(suffix="  # jaxlint: disable=JL001")) == []
+
+
+def test_suppression_tolerates_trailing_prose(tmp_path):
+    src = _REUSE.format(suffix="  # jaxlint: disable=JL001 (correlated draws are intentional here)")
+    assert _lint_file(tmp_path, src) == []
+
+
+def test_standalone_comment_suppresses_next_line(tmp_path):
+    src = """
+    import jax
+
+    def f(key):
+        a = jax.random.normal(key, (3,))
+        # jaxlint: disable=JL001
+        b = jax.random.uniform(key, (3,))
+        return a + b
+    """
+    assert _lint_file(tmp_path, src) == []
+
+
+def test_disable_all_and_other_rule(tmp_path):
+    assert _lint_file(tmp_path, _REUSE.format(suffix="  # jaxlint: disable=all")) == []
+    # suppressing a different rule leaves the finding alone
+    assert _lint_file(tmp_path, _REUSE.format(suffix="  # jaxlint: disable=JL005")) != []
+
+
+def test_parse_suppressions_map():
+    src = "x = 1  # jaxlint: disable=JL001,JL004\n# jaxlint: disable=all\ny = 2\n"
+    sup = parse_suppressions(src)
+    assert sup[1] == {"JL001", "JL004"}
+    assert sup[3] == {"all"}
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = _lint_file(tmp_path, _REUSE.format(suffix=""))
+    assert findings
+    baseline_path = tmp_path / "base.txt"
+    write_baseline(findings, baseline_path)
+    baseline = load_baseline(baseline_path)
+    assert filter_baseline(findings, baseline) == []
+    # a different finding is NOT filtered
+    other = Finding("JL001", "elsewhere.py", 1, 0, "msg", "f:key")
+    assert filter_baseline([other], baseline) == [other]
+    # and the baseline also filters through run_lint itself
+    assert _lint_file(tmp_path, _REUSE.format(suffix=""), baseline=baseline) == []
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(tmp_path / "nope.txt") == set()
+
+
+def test_cli_exit_codes(tmp_path):
+    from sheeprl_tpu.analysis.__main__ import main
+
+    mod = tmp_path / "m.py"
+    mod.write_text(textwrap.dedent(_REUSE.format(suffix="")))
+    base = tmp_path / "b.txt"
+    assert main([str(mod), "--no-baseline", "--root", str(tmp_path), "-q"]) == 1
+    assert main([str(mod), "--write-baseline", "--baseline", str(base), "--root", str(tmp_path), "-q"]) == 0
+    assert main([str(mod), "--baseline", str(base), "--root", str(tmp_path), "-q"]) == 0
+    assert main([str(mod), "--select", "JL999"]) == 2

@@ -1,0 +1,376 @@
+"""One positive and one negative fixture per jaxlint rule (JL001–JL006)."""
+
+import textwrap
+
+import pytest
+
+from sheeprl_tpu.analysis.engine import run_lint
+from sheeprl_tpu.analysis.rules import default_rules
+from tests.test_analysis.conftest import rule_ids
+
+
+# ------------------------------------------------------------------------- JL001
+def test_jl001_positive_reuse(lint):
+    findings = lint(
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return a + b
+        """
+    )
+    assert "JL001" in rule_ids(findings)
+
+
+def test_jl001_positive_loop_carried(lint):
+    findings = lint(
+        """
+        import jax
+
+        def f(key, xs):
+            out = []
+            for x in xs:
+                out.append(jax.random.normal(key, (3,)))
+            return out
+        """
+    )
+    assert "JL001" in rule_ids(findings)
+
+
+def test_jl001_negative_split(lint):
+    findings = lint(
+        """
+        import jax
+
+        def f(key):
+            key, k1 = jax.random.split(key)
+            a = jax.random.normal(k1, (3,))
+            key, k2 = jax.random.split(key)
+            b = jax.random.uniform(k2, (3,))
+            return a + b
+
+        def loop(key, xs):
+            for x in xs:
+                key, sub = jax.random.split(key)
+                x = jax.random.normal(sub, (3,))
+            return x
+        """
+    )
+    assert "JL001" not in rule_ids(findings)
+
+
+def test_jl001_negative_exclusive_branches(lint):
+    # the dreamer pattern: both branches consume the key, but only one runs
+    findings = lint(
+        """
+        import jax
+
+        def f(key, flag):
+            if flag:
+                ks = jax.random.split(key, 3)
+            else:
+                ks = jax.random.split(key, 5)
+            return ks
+
+        def g(key, cont):
+            if cont:
+                return jax.random.normal(key, (2,))
+            k1, k2 = jax.random.split(key)
+            return jax.random.uniform(k1, (2,))
+        """
+    )
+    assert "JL001" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------------- JL002
+def test_jl002_positive_if_and_while(lint):
+    findings = lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            while x < 10:
+                x = x + 1
+            return x
+        """
+    )
+    assert rule_ids(findings).count("JL002") == 2
+
+
+def test_jl002_positive_scan_body(lint):
+    findings = lint(
+        """
+        import jax
+
+        def outer(xs):
+            def body(carry, x):
+                if x > 0:
+                    carry = carry + x
+                return carry, x
+            return jax.lax.scan(body, 0.0, xs)
+        """
+    )
+    assert "JL002" in rule_ids(findings)
+
+
+def test_jl002_negative_static_conditions(lint):
+    findings = lint(
+        """
+        import jax
+
+        @jax.jit
+        def f(x, flag_from_closure):
+            cfg_flag = True
+            if cfg_flag:
+                x = x * 2
+            if x.shape[0] == 3:
+                x = x + 1
+            if len(x.shape) > 1:
+                x = x.sum()
+            y = jax.numpy.where(x > 0, x, -x)
+            return y
+        """
+    )
+    assert "JL002" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------------- JL003
+def test_jl003_positive_host_sync_in_loop(lint):
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def train(step, data):
+            step = jax.jit(step)
+            total = 0.0
+            for batch in data:
+                loss = step(batch)
+                total += float(loss)
+                _ = loss.item()
+                _ = np.asarray(loss)
+            return total
+        """
+    )
+    assert rule_ids(findings).count("JL003") == 3
+
+
+def test_jl003_negative_explicit_sync_and_host_values(lint):
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def train(step, data, env):
+            step = jax.jit(step)
+            for batch in data:
+                loss = step(batch)
+                host = jax.device_get(loss)      # explicit, deliberate sync
+                total = float(host)
+                obs, reward = env.step(np.ones(3))  # host values from the env
+                r = float(reward)
+            out = step(data[0])
+            final = float(out)                    # outside the loop: fine
+            return total, r, final
+        """
+    )
+    assert "JL003" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------------- JL004
+def test_jl004_positive_jit_in_loop(lint):
+    findings = lint(
+        """
+        import jax
+
+        def f(fns, x):
+            for fn in fns:
+                g = jax.jit(fn)
+                x = g(x)
+            return x
+        """
+    )
+    assert "JL004" in rule_ids(findings)
+
+
+def test_jl004_positive_varying_static_arg(lint):
+    findings = lint(
+        """
+        import jax
+
+        def f(x):
+            g = jax.jit(lambda a, n: a * n, static_argnums=(1,))
+            for n in range(10):
+                x = g(x, n)
+            return x
+        """
+    )
+    assert any(f.rule == "JL004" and "static" in f.message for f in findings)
+
+
+def test_jl004_positive_mutable_closure(lint):
+    findings = lint(
+        """
+        import jax
+
+        def train(x, steps):
+            params = init()
+
+            @jax.jit
+            def step(v):
+                return params @ v
+
+            for _ in range(steps):
+                params = update(params)
+                x = step(x)
+            return x
+        """
+    )
+    assert any(f.rule == "JL004" and "closes over" in f.message for f in findings)
+
+
+def test_jl004_negative_hoisted_jit(lint):
+    findings = lint(
+        """
+        import jax
+
+        def f(fn, xs):
+            g = jax.jit(fn, static_argnums=(1,))
+            n = 4
+            out = []
+            for x in xs:
+                out.append(g(x, n))
+            return out
+        """
+    )
+    assert "JL004" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------------- JL005
+def test_jl005_positive_use_after_donation(lint):
+    findings = lint(
+        """
+        import jax
+
+        def f(params, batch):
+            step = jax.jit(train, donate_argnums=(0,))
+            new_params = step(params, batch)
+            return params + new_params
+        """
+    )
+    assert "JL005" in rule_ids(findings)
+
+
+def test_jl005_positive_loop_without_rebind(lint):
+    findings = lint(
+        """
+        import jax
+
+        def f(params, batches):
+            step = jax.jit(train, donate_argnums=(0,))
+            outs = []
+            for b in batches:
+                outs.append(step(params, b))
+            return outs
+        """
+    )
+    assert "JL005" in rule_ids(findings)
+
+
+def test_jl005_negative_rebound(lint):
+    findings = lint(
+        """
+        import jax
+
+        def f(params, batches):
+            step = jax.jit(train, donate_argnums=(0,))
+            for b in batches:
+                params = step(params, b)
+            return params
+        """
+    )
+    assert "JL005" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------------- JL006
+@pytest.fixture()
+def config_tree(tmp_path):
+    cfg = tmp_path / "configs"
+    (cfg / "algo").mkdir(parents=True)
+    (cfg / "config.yaml").write_text("defaults:\n  - algo: tuned\nseed: 42\nunused_root: 1\n")
+    (cfg / "algo" / "tuned.yaml").write_text("name: tuned\noptimizer:\n  lr: 1e-3\n")
+    return cfg
+
+
+def _lint_jl006(tmp_path, config_tree, source):
+    mod = tmp_path / "snippet.py"
+    mod.write_text(textwrap.dedent(source))
+    return run_lint([mod], rules=default_rules(["JL006"]), config_dir=config_tree, root=tmp_path)
+
+
+def test_jl006_positive_undefined_and_unused(tmp_path, config_tree):
+    findings = _lint_jl006(
+        tmp_path,
+        config_tree,
+        """
+        def main(cfg):
+            lr = cfg.algo.optimizer.get("lr", 1e-3)
+            eps = cfg.algo.optimizer.get("eps", 1e-8)   # not in YAML -> drift
+            return lr, eps
+        """,
+    )
+    details = {f.detail for f in findings}
+    assert "undefined:algo.optimizer.eps" in details
+    assert "unused:unused_root" in details  # defined in YAML, never read
+    assert "undefined:algo.optimizer.lr" not in details
+
+
+def test_jl006_negative_all_defined_and_used(tmp_path, config_tree):
+    findings = _lint_jl006(
+        tmp_path,
+        config_tree,
+        """
+        def main(cfg):
+            s = cfg.seed
+            u = cfg.get("unused_root")
+            name = cfg.algo.name
+            return s, u, name, cfg.algo.optimizer.lr
+        """,
+    )
+    assert findings == []
+
+
+def test_jl006_param_propagation(tmp_path, config_tree):
+    # make_optimizer-style: the sub-config access happens in a helper
+    findings = _lint_jl006(
+        tmp_path,
+        config_tree,
+        """
+        def make_opt(opt_cfg):
+            return opt_cfg.get("lr"), opt_cfg.get("weight_decay", 0.0)
+
+        def main(cfg):
+            _ = cfg.seed, cfg.algo.name, cfg.unused_root
+            return make_opt(cfg.algo.optimizer)
+        """,
+    )
+    assert "undefined:algo.optimizer.weight_decay" in {f.detail for f in findings}
+
+
+def test_jl006_local_alias_resolution(tmp_path, config_tree):
+    findings = _lint_jl006(
+        tmp_path,
+        config_tree,
+        """
+        def main(cfg):
+            _ = cfg.seed, cfg.unused_root
+            opt = cfg.algo.optimizer
+            return opt.lr, opt.get("typo_key"), cfg.algo.name
+        """,
+    )
+    assert "undefined:algo.optimizer.typo_key" in {f.detail for f in findings}

@@ -1,0 +1,150 @@
+"""Runtime strict mode: signature guards, NaN scans, and the watchdog hard error."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.analysis.strict import (
+    NonFiniteError,
+    SignatureDriftError,
+    assert_finite,
+    clear_pending,
+    nan_scan,
+    raise_pending,
+    registered_guards,
+    strict_enabled,
+    strict_guard,
+)
+from sheeprl_tpu.obs.monitor import TrainingMonitor
+from sheeprl_tpu.obs.watchdog import RecompileError
+
+STRICT = {"analysis": {"strict": True}}
+LAX = {"analysis": {"strict": False}}
+
+
+@pytest.fixture(autouse=True)
+def _clean_pending():
+    clear_pending()
+    yield
+    clear_pending()
+
+
+def test_strict_enabled_parsing():
+    assert strict_enabled(STRICT)
+    assert not strict_enabled(LAX)
+    assert not strict_enabled({})
+    assert not strict_enabled(None)
+    assert not strict_enabled({"analysis": None})
+
+
+# ------------------------------------------------------------- signature guard
+def test_guard_passes_stable_signature_and_registers():
+    f = strict_guard(STRICT, "test/stable", jax.jit(lambda x: x + 1))
+    x = np.ones((4, 2), np.float32)
+    assert np.allclose(f(x), x + 1)
+    assert np.allclose(f(x), x + 1)
+    assert "test/stable" in registered_guards()
+
+
+def test_guard_raises_on_shape_drift():
+    f = strict_guard(STRICT, "test/drift", jax.jit(lambda x: x * 2))
+    f(np.ones(3, np.float32))
+    with pytest.raises(SignatureDriftError, match="drifting signature"):
+        f(np.ones(5, np.float32))
+
+
+def test_guard_raises_on_dtype_drift():
+    f = strict_guard(STRICT, "test/dtype", jax.jit(lambda x: x * 2))
+    f(np.ones(3, np.float32))
+    with pytest.raises(SignatureDriftError):
+        f(np.ones(3, np.float64))
+
+
+def test_guard_raises_on_structure_drift():
+    f = strict_guard(STRICT, "test/tree", jax.jit(lambda t: jax.tree.map(lambda v: v * 2, t)))
+    f({"a": np.ones(3, np.float32)})
+    with pytest.raises(SignatureDriftError):
+        f({"a": np.ones(3, np.float32), "b": np.ones(3, np.float32)})
+
+
+def test_guard_is_identity_when_off():
+    fn = jax.jit(lambda x: x)
+    assert strict_guard(LAX, "test/off", fn) is fn
+
+
+# ----------------------------------------------------------------- NaN scanning
+def test_nan_scan_inside_jit_detected_at_boundary():
+    @jax.jit
+    def step(x):
+        y = x / x  # NaN at 0
+        nan_scan({"loss": y}, "test/step")
+        return y
+
+    jax.block_until_ready(step(jnp.zeros(3)))
+    with pytest.raises(NonFiniteError, match="loss"):
+        raise_pending()
+    raise_pending()  # drained: second call is clean
+
+
+def test_nan_scan_clean_values_do_not_raise():
+    @jax.jit
+    def step(x):
+        nan_scan({"loss": x * 2}, "test/clean")
+        return x
+
+    jax.block_until_ready(step(jnp.ones(3)))
+    raise_pending()
+
+
+def test_assert_finite_host_side():
+    with pytest.raises(NonFiniteError, match="bad"):
+        assert_finite(STRICT, {"bad": np.array([1.0, np.nan])}, "test")
+    assert_finite(STRICT, {"ok": np.ones(3), "ints": np.arange(3)}, "test")
+    # off: no-op even on NaN
+    assert_finite(LAX, {"bad": np.array([np.inf])}, "test")
+
+
+# --------------------------------------------------- watchdog: warning -> error
+def _monitor(strict: bool, tmp_path):
+    cfg = {
+        "obs": {"enabled": True, "trace": False, "telemetry": False, "xprof_annotations": False},
+        "analysis": {"strict": strict},
+    }
+    return TrainingMonitor(cfg, str(tmp_path))
+
+
+def test_forced_post_warmup_recompile_is_hard_error_in_strict(tmp_path):
+    m = _monitor(True, tmp_path)
+    try:
+        @jax.jit
+        def f(x):
+            return jnp.sin(x)
+
+        x3 = jax.device_put(np.ones(3, np.float32))
+        x7 = jax.device_put(np.ones(7, np.float32))
+        jax.block_until_ready(f(x3))
+        m.advance()  # update 1: warmup
+        m.advance()  # update 2: mark_warm
+        jax.block_until_ready(f(x7))  # forced post-warmup recompile
+        with pytest.raises(RecompileError, match="recompilation"):
+            m.advance()
+    finally:
+        m.close()
+
+
+def test_same_recompile_only_warns_without_strict(tmp_path):
+    m = _monitor(False, tmp_path)
+    try:
+        @jax.jit
+        def g(x):
+            return jnp.cos(x)
+
+        jax.block_until_ready(g(jax.device_put(np.ones(3, np.float32))))
+        m.advance()
+        m.advance()
+        jax.block_until_ready(g(jax.device_put(np.ones(9, np.float32))))
+        with pytest.warns(UserWarning, match="recompilation"):
+            m.advance()
+    finally:
+        m.close()
