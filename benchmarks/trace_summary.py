@@ -1,12 +1,19 @@
 #!/usr/bin/env python
-"""Fold a Chrome-trace JSON (exported by ``sheeprl_tpu.obs``) into a per-phase table.
+"""Fold a Chrome-trace JSON (exported by ``sheeprl_tpu.obs``) OR a flight-recorder
+blackbox event log into a per-phase table.
 
 Usage:
     python benchmarks/trace_summary.py <log_dir>/trace.json [--json]
+    python benchmarks/trace_summary.py <log_dir>/blackbox/events.jsonl [--json]
 
 Per span name: call count, total time, share of the top-level (depth-0) wall clock, and
 p50/p95/p99 latencies.  ``--json`` emits the same table as a JSON object for BENCH
 report collection scripts.
+
+Blackbox event JSONL (one JSON object per line, ``obs/flight_recorder.py``) is
+detected automatically: ``span`` events feed the same per-phase table (depth from
+the recorder), every other event kind is summarized by count — so one tool reads
+both live traces and post-mortem dumps.
 """
 
 from __future__ import annotations
@@ -17,7 +24,64 @@ import sys
 from typing import Any, Dict, List
 
 
+def _load_blackbox_events(path: str) -> List[Dict[str, Any]]:
+    """Parse a flight-recorder events.jsonl file into a list of event dicts."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(event, dict) and "kind" in event:
+                events.append(event)
+    return events
+
+
+def _is_blackbox_log(path: str) -> bool:
+    if path.endswith(".jsonl"):
+        return True
+    with open(path) as f:
+        head = f.read(2048).lstrip()
+    if not head.startswith("{"):
+        return False
+    try:
+        first = json.loads(head.splitlines()[0])
+    except json.JSONDecodeError:
+        return False
+    return isinstance(first, dict) and "kind" in first
+
+
+def summarize_blackbox(path: str) -> Dict[str, Any]:
+    """Blackbox events -> the same per-phase summary shape as :func:`summarize`,
+    plus an ``events`` section counting the non-span kinds (restarts, recompiles,
+    metric flushes, strict trips) that tell the crash story."""
+    raw = _load_blackbox_events(path)
+    phases: Dict[str, List[float]] = {}
+    kinds: Dict[str, int] = {}
+    top_level_total = 0.0
+    for event in raw:
+        if event.get("kind") == "span":
+            dur_ms = float(event.get("dur_ms", 0.0))
+            phases.setdefault(str(event.get("name", "?")), []).append(dur_ms)
+            if int(event.get("depth", 0)) == 0:
+                top_level_total += dur_ms
+        else:
+            kinds[str(event["kind"])] = kinds.get(str(event["kind"]), 0) + 1
+    summary = _phase_rows(path, phases, top_level_total)
+    summary["events"] = dict(sorted(kinds.items(), key=lambda kv: -kv[1]))
+    span = [e.get("ts") for e in raw if isinstance(e.get("ts"), (int, float))]
+    if span:
+        summary["window_s"] = max(span) - min(span)
+    return summary
+
+
 def summarize(path: str) -> Dict[str, Any]:
+    if _is_blackbox_log(path):
+        return summarize_blackbox(path)
     with open(path) as f:
         doc = json.load(f)
     events = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
@@ -28,6 +92,10 @@ def summarize(path: str) -> Dict[str, Any]:
         phases.setdefault(e["name"], []).append(dur_ms)
         if e.get("args", {}).get("depth", 0) == 0:
             top_level_total += dur_ms
+    return _phase_rows(path, phases, top_level_total)
+
+
+def _phase_rows(path: str, phases: Dict[str, List[float]], top_level_total: float) -> Dict[str, Any]:
     rows = {}
     for name, durs in phases.items():
         durs = sorted(durs)
@@ -78,12 +146,19 @@ def format_table(summary: Dict[str, Any]) -> str:
     for r in rows:
         lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
     lines.append(f"top-level wall clock: {summary['top_level_total_ms']:.2f} ms")
+    if summary.get("events"):
+        lines.append("")
+        lines.append("flight-recorder events:")
+        for kind, count in summary["events"].items():
+            lines.append(f"  {kind}: {count}")
+        if "window_s" in summary:
+            lines.append(f"  (window: {summary['window_s']:.1f} s)")
     return "\n".join(lines)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("trace", help="Chrome-trace JSON file (e.g. <log_dir>/trace.json)")
+    parser.add_argument("trace", help="Chrome-trace JSON (<log_dir>/trace.json) or blackbox events.jsonl")
     parser.add_argument("--json", action="store_true", help="emit JSON instead of a table")
     args = parser.parse_args(argv)
     summary = summarize(args.trace)

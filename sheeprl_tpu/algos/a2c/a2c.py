@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.analysis.strict import assert_finite, strict_guard
+from sheeprl_tpu.analysis.strict import assert_finite, maybe_inject_nonfinite, strict_guard
 from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, value_loss
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
@@ -24,7 +24,8 @@ from sheeprl_tpu.algos.ppo.utils import log_prob_and_entropy, prepare_obs, sampl
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
+from sheeprl_tpu.obs.health import diagnostics, health_enabled
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -33,6 +34,46 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import gae, normalize_tensor
 
 AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss"}
+
+
+def make_a2c_train_fn(ctx, agent, cfg, obs_keys):
+    """Optimizer + the jitted full-batch A2C update.
+
+    Module-level (rather than a closure in ``main``) so the flight recorder's
+    :func:`replay_update` can rebuild the exact update from a blackbox dump."""
+    opt = make_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    reduction = cfg.algo.loss_reduction
+    is_continuous = agent.is_continuous
+    health = health_enabled(cfg)  # trace-time constant (obs/health.py)
+
+    def loss_fn(p, data):
+        actor_out, new_values = agent.apply(p, {k: data[k] for k in obs_keys})
+        logprob, entropy = log_prob_and_entropy(actor_out, data["actions"], is_continuous)
+        adv = data["advantages"]
+        if cfg.algo.normalize_advantages:
+            adv = normalize_tensor(adv)
+        obj = logprob * adv
+        pg = -(obj.mean() if reduction == "mean" else obj.sum())
+        vf = value_loss(new_values[..., 0], data["values"], data["returns"], 0.0, False, reduction)
+        ent = entropy_loss(entropy, reduction)
+        total = pg + cfg.algo.vf_coef * vf + cfg.algo.ent_coef * ent
+        aux = {"Loss/policy_loss": pg, "Loss/value_loss": vf}
+        if health:
+            aux["Health/policy_entropy"] = entropy.mean()
+            aux["Health/value_mean"] = new_values.mean()
+        return total, aux
+
+    @jax.jit
+    def train_fn(p, o_state, data):
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, data)
+        updates, o_state = opt.update(grads, o_state, p)
+        p = optax.apply_updates(p, updates)
+        if health:
+            aux = {**aux, **diagnostics(grads=grads, params=p, updates=updates)}
+        aux = maybe_inject_nonfinite(cfg, aux)
+        return p, o_state, aux
+
+    return opt, train_fn
 
 
 @register_algorithm(name="a2c")
@@ -53,7 +94,7 @@ def main(ctx, cfg) -> None:
 
     agent, params = build_agent(ctx, act_space, obs_space, cfg)
     is_continuous = agent.is_continuous
-    opt = make_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    opt, train_fn = make_a2c_train_fn(ctx, agent, cfg, obs_keys)
     opt_state = ctx.replicate(opt.init(params))
 
     num_envs = cfg.env.num_envs
@@ -75,7 +116,6 @@ def main(ctx, cfg) -> None:
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
 
     gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
-    reduction = cfg.algo.loss_reduction
 
     @jax.jit
     def act_fn(p, obs, key):
@@ -89,27 +129,18 @@ def main(ctx, cfg) -> None:
 
     gae_fn = jax.jit(lambda r, v, d, nv: gae(r, v, d, nv, rollout_steps, gamma, gae_lambda))
 
-    def loss_fn(p, data):
-        actor_out, new_values = agent.apply(p, {k: data[k] for k in obs_keys})
-        logprob, entropy = log_prob_and_entropy(actor_out, data["actions"], is_continuous)
-        adv = data["advantages"]
-        if cfg.algo.normalize_advantages:
-            adv = normalize_tensor(adv)
-        obj = logprob * adv
-        pg = -(obj.mean() if reduction == "mean" else obj.sum())
-        vf = value_loss(new_values[..., 0], data["values"], data["returns"], 0.0, False, reduction)
-        ent = entropy_loss(entropy, reduction)
-        total = pg + cfg.algo.vf_coef * vf + cfg.algo.ent_coef * ent
-        return total, {"Loss/policy_loss": pg, "Loss/value_loss": vf}
-
-    @jax.jit
-    def train_fn(p, o_state, data):
-        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, data)
-        updates, o_state = opt.update(grads, o_state, p)
-        return optax.apply_updates(p, updates), o_state, aux
-
     # analysis.strict: signature guard on the jitted update (drift -> hard error)
     train_fn = strict_guard(cfg, "a2c/train_fn", train_fn)
+
+    # Flight recorder: arm the replay builder with everything needed to rebuild
+    # this update from the dump alone.
+    recorder = flight_recorder.get_active()
+    if recorder is not None:
+        recorder.arm_replay(
+            "sheeprl_tpu.algos.a2c.a2c:replay_update",
+            act_space=act_space,
+            obs_space=obs_space,
+        )
 
     start_update, policy_step, last_log, last_checkpoint = 1, 0, 0, 0
     if cfg.checkpoint.get("resume_from"):
@@ -131,8 +162,9 @@ def main(ctx, cfg) -> None:
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
-                obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
-                env_act, _, logprob, value = act_fn(params, obs_t, ctx.local_rng())
+                with monitor.phase("player"):
+                    obs_t = prepare_obs(obs, cnn_keys, mlp_keys)
+                    env_act, _, logprob, value = act_fn(params, obs_t, ctx.local_rng())
                 env_act_np = np.asarray(jax.device_get(env_act))
                 if is_continuous:
                     low, high = act_space.low, act_space.high
@@ -141,7 +173,8 @@ def main(ctx, cfg) -> None:
                     env_actions = env_act_np[..., 0]
                 else:
                     env_actions = env_act_np
-                next_obs, reward, terminated, truncated, info = envs.step(env_actions)
+                with monitor.phase("env_step"):
+                    next_obs, reward, terminated, truncated, info = envs.step(env_actions)
                 done = np.logical_or(terminated, truncated)
                 reward = np.asarray(reward, dtype=np.float32).reshape(num_envs)
                 if truncated.any() and "final_obs" in info:
@@ -157,7 +190,8 @@ def main(ctx, cfg) -> None:
                 step_data["values"] = np.asarray(jax.device_get(value)).reshape(num_envs, 1)[None]
                 step_data["rewards"] = reward.reshape(num_envs, 1)[None]
                 step_data["dones"] = done.astype(np.float32).reshape(num_envs, 1)[None]
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                with monitor.phase("buffer_add"):
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
                 obs = next_obs
                 policy_step += num_envs * world
                 record_episode_stats(aggregator, info)
@@ -177,7 +211,13 @@ def main(ctx, cfg) -> None:
         data = jax.tree.map(lambda x: x.reshape(batch_n, *x.shape[2:]), data)
         data = ctx.put_batch(data, batch_axis=0)
 
-        with timer("Time/train_time"):
+        if recorder is not None:  # device-array references only: no host sync
+            recorder.stage_step(
+                batch=data,
+                carry={"params": params, "opt_state": opt_state},
+                scalars={"update": update},
+            )
+        with timer("Time/train_time"), monitor.phase("dispatch"):
             t0 = time.perf_counter()
             params, opt_state, train_metrics = train_fn(params, opt_state, data)
             train_metrics = jax.device_get(train_metrics)
@@ -200,17 +240,18 @@ def main(ctx, cfg) -> None:
             or update == num_updates
             and cfg.checkpoint.save_last
         ):
-            ckpt_manager.save(
-                policy_step,
-                {
-                    "params": params,
-                    "opt_state": opt_state,
-                    "update": update,
-                    "policy_step": policy_step,
-                    "last_log": last_log,
-                    "last_checkpoint": policy_step,
-                },
-            )
+            with monitor.phase("checkpoint"):
+                ckpt_manager.save(
+                    policy_step,
+                    {
+                        "params": params,
+                        "opt_state": opt_state,
+                        "update": update,
+                        "policy_step": policy_step,
+                        "last_log": last_log,
+                        "last_checkpoint": policy_step,
+                    },
+                )
             last_checkpoint = policy_step
 
     monitor.close()
@@ -221,3 +262,27 @@ def main(ctx, cfg) -> None:
             logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
     if logger is not None:
         logger.close()
+
+
+def replay_update(cfg, dump_dir):
+    """Flight-recorder replay builder: re-execute the dumped A2C update on CPU."""
+    from sheeprl_tpu.obs import replay_blackbox
+    from sheeprl_tpu.parallel.mesh import make_mesh_context
+
+    ctx = make_mesh_context(cfg)
+    raw = replay_blackbox.load_state(dump_dir)
+    statics = raw["statics"]
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+    agent, params0 = build_agent(ctx, statics["act_space"], statics["obs_space"], cfg)
+    opt, train_fn = make_a2c_train_fn(ctx, agent, cfg, obs_keys)
+    templates = {"carry": jax.device_get({"params": params0, "opt_state": opt.init(params0)})}
+    state = replay_blackbox.load_state(dump_dir, templates)
+    new_params, _, metrics = train_fn(
+        ctx.replicate(state["carry"]["params"]),
+        ctx.replicate(state["carry"]["opt_state"]),
+        state["batch"],
+    )
+    return {
+        "metrics": jax.device_get(metrics),
+        "new_param_norm": float(jax.device_get(optax.global_norm(new_params))),
+    }

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled
+from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite, nan_scan, strict_enabled
 from sheeprl_tpu.algos.dreamer_v1.agent import (
     PlayerState,
     WorldModelV1,
@@ -47,6 +47,7 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialRepla
 from sheeprl_tpu.data.device_buffer import make_device_replay
 from sheeprl_tpu.distributions import BernoulliSafeMode, Independent, Normal
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.rollout import rollout_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -201,6 +202,15 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys):
         metrics["Grads/world_model"] = optax.global_norm(wm_grads)
         metrics["Grads/actor"] = optax.global_norm(actor_grads)
         metrics["Grads/critic"] = optax.global_norm(critic_grads)
+        if health_enabled(cfg):  # trace-time constant (obs/health.py)
+            metrics.update(
+                diagnostics(
+                    grads={"world_model": wm_grads, "actor": actor_grads, "critic": critic_grads},
+                    params=new_params,
+                    updates={"world_model": wm_updates, "actor": actor_updates, "critic": critic_updates},
+                )
+            )
+        metrics = maybe_inject_nonfinite(cfg, metrics)
         if strict_enabled(cfg):  # trace-time constant: callback exists only in strict runs
             nan_scan(metrics, "dreamer_v1/train_step")
         return new_params, new_opt_states, metrics
@@ -438,6 +448,7 @@ def main(ctx, cfg) -> None:
                 cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
             )
             metrics["Params/exploration_amount"] = expl_amount
+            metrics.update(replay_age_metrics(rb))
             metrics.update(rollout_metrics(envs))
             monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()

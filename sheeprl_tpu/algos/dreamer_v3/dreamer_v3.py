@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled
+from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite, nan_scan, strict_enabled
 from sheeprl_tpu.algos.dreamer_v3.agent import (
     PlayerState,
     WorldModel,
@@ -57,7 +57,8 @@ from sheeprl_tpu.distributions import (
     SymlogDistribution,
     TwoHotEncodingDistribution,
 )
-from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
+from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.rollout import PipelinedPlayer, rollout_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -319,6 +320,16 @@ def make_train_step(world_model, actor, critic, cfg, cnn_keys, mlp_keys, obs_sha
         metrics["Grads/world_model"] = optax.global_norm(wm_grads)
         metrics["Grads/actor"] = optax.global_norm(actor_grads)
         metrics["Grads/critic"] = optax.global_norm(critic_grads)
+        if health_enabled(cfg):  # trace-time constant (obs/health.py)
+            metrics.update(
+                diagnostics(
+                    grads={"world_model": wm_grads, "actor": actor_grads, "critic": critic_grads},
+                    params=new_params,
+                    updates={"world_model": wm_updates, "actor": actor_updates, "critic": critic_updates},
+                    aux={"critic_value_mean": lambda_values.mean(), "critic_value_std": lambda_values.std()},
+                )
+            )
+        metrics = maybe_inject_nonfinite(cfg, metrics)
         if strict_enabled(cfg):  # trace-time constant: callback exists only in strict runs
             nan_scan(metrics, "dreamer_v3/train_step")
         return new_params, new_opt_states, actor_aux["moments"], metrics
@@ -350,6 +361,15 @@ def main(ctx, cfg) -> None:
     train_step, init_opt_states = make_train_step(
         world_model, actor, critic, cfg, cnn_keys, mlp_keys, {k: obs_space[k].shape for k in obs_keys}
     )
+    # Flight recorder: replay_update rebuilds this exact train step from the dump.
+    recorder = flight_recorder.get_active()
+    if recorder is not None:
+        recorder.arm_replay(
+            "sheeprl_tpu.algos.dreamer_v3.dreamer_v3:replay_update",
+            obs_space=obs_space,
+            actions_dim=tuple(int(d) for d in actions_dim),
+            is_continuous=bool(is_continuous),
+        )
     # opt states mirror the params' (possibly tensor-parallel) placement
     opt_states = ctx.shard_params(init_opt_states(params))
     moments_state = ctx.replicate(init_moments())
@@ -514,7 +534,7 @@ def main(ctx, cfg) -> None:
             monitor.advance()
             env_time = 0.0
             env_t0 = time.perf_counter()
-            with timer("Time/env_interaction_time"), timer("Time/phase_player"):
+            with timer("Time/env_interaction_time"), monitor.phase("player"):
                 if iter_num <= learning_starts and not cfg.checkpoint.get("resume_from"):
                     if is_continuous:
                         stored_actions = np.stack([act_space.sample() for _ in range(num_envs)]).astype(np.float32)
@@ -538,7 +558,7 @@ def main(ctx, cfg) -> None:
                 # (under the prefetcher's lock: the sampler thread must not read rows
                 # mid-write).
                 step_data["actions"] = stored_actions.reshape(1, num_envs, -1)
-                with timer("Time/phase_buffer_add"):
+                with monitor.phase("buffer_add"):
                     rb_add(step_data, validate_args=cfg.buffer.validate_args)
             env_time += time.perf_counter() - env_t0
 
@@ -553,7 +573,7 @@ def main(ctx, cfg) -> None:
                     (policy_step + policy_steps_per_iter - prefill_iters * policy_steps_per_iter) / world
                 )
                 if grad_steps > 0:
-                    with timer("Time/phase_dispatch"):
+                    with monitor.phase("dispatch"):
                         params, opt_states, moments_state = _run_block(
                             (params, opt_states, moments_state),
                             grad_steps,
@@ -563,7 +583,7 @@ def main(ctx, cfg) -> None:
                     cumulative_grad_steps += grad_steps
 
             env_t0 = time.perf_counter()
-            with timer("Time/env_interaction_time"), timer("Time/phase_env_step"):
+            with timer("Time/env_interaction_time"), monitor.phase("env_step"):
                 next_obs, reward, terminated, truncated, info = rollout_player.env_step(env_actions)
                 if cfg.env.clip_rewards:
                     reward = np.clip(reward, -1, 1)
@@ -628,7 +648,7 @@ def main(ctx, cfg) -> None:
                     "last_checkpoint": policy_step,
                     "cumulative_grad_steps": cumulative_grad_steps,
                 }
-                with timer("Time/phase_checkpoint"):
+                with monitor.phase("checkpoint"):
                     if cfg.buffer.checkpoint:
                         state["rb"] = rb.state_dict()
                     ckpt_manager.save(policy_step, state)
@@ -640,12 +660,12 @@ def main(ctx, cfg) -> None:
                 # The drain below is the window's only blocking sync: it waits for
                 # every gradient block dispatched in the window, so the window
                 # wall-clock is an honest end-to-end grad-steps/s denominator.
-                with timer("Time/phase_drain"):
+                with monitor.phase("drain"):
                     dispatcher.drain(aggregator)
                 metrics = aggregator.compute()
-                # Per-phase wall-clock breakdown over the window (seconds); the
-                # nested player timer includes buffer_add — subtract when reading.
-                metrics.update(timer.to_dict(reset=True))
+                # The per-phase Time/phase_* breakdown is folded in by
+                # monitor.log_metrics (the nested player timer includes
+                # buffer_add — subtract when reading).
                 window_sps = dispatcher.pop_window_sps()
                 if window_sps is not None:
                     metrics["Time/sps_train"] = window_sps
@@ -655,6 +675,7 @@ def main(ctx, cfg) -> None:
                 metrics["Params/replay_ratio"] = (
                     cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
                 )
+                metrics.update(replay_age_metrics(rb))
                 metrics.update(rollout_metrics(envs))
                 monitor.log_metrics(logger, metrics, policy_step)
                 aggregator.reset()
@@ -675,3 +696,51 @@ def main(ctx, cfg) -> None:
         maybe_register_models(cfg, log_dir)
     if logger is not None:
         logger.close()
+
+
+def replay_update(cfg, dump_dir):
+    """Flight-recorder replay builder: re-execute the dumped DreamerV3 gradient
+    block on CPU — the same ``make_train_block`` chunking the dispatcher used, fed
+    the dumped per-step batches, carry and base key, so the re-execution is
+    bit-equivalent to the crashed dispatch."""
+    from sheeprl_tpu.obs import replay_blackbox
+    from sheeprl_tpu.parallel.mesh import make_mesh_context
+    from sheeprl_tpu.utils.blocks import chunk_sizes, make_train_block
+
+    ctx = make_mesh_context(cfg)
+    raw = replay_blackbox.load_state(dump_dir)
+    statics = raw["statics"]
+    obs_space = statics["obs_space"]
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    world_model, actor, critic, params0, _ = build_agent(
+        ctx, tuple(statics["actions_dim"]), statics["is_continuous"], cfg, obs_space
+    )
+    train_step, init_opt_states = make_train_step(
+        world_model, actor, critic, cfg, cnn_keys, mlp_keys, {k: obs_space[k].shape for k in obs_keys}
+    )
+    carry0 = (params0, init_opt_states(params0), init_moments())
+    state = replay_blackbox.load_state(dump_dir, templates={"carry": jax.device_get(carry0)})
+    batches = replay_blackbox.as_step_list(state["batches"])
+    bk = dict(statics.get("block_kwargs") or {})
+
+    def _block_step(carry, batch, key, update_target):
+        params, opt_states, moments = carry
+        params, opt_states, moments, metrics = train_step(
+            params, opt_states, moments, batch, key, update_target
+        )
+        return (params, opt_states, moments), metrics
+
+    block = make_train_block(_block_step, bk.get("target_update_freq", 1), bk.get("count_offset", 1))
+    carry = tuple(state["carry"])
+    start_count = int(state["scalars"]["start_count"])
+    base_key = jnp.asarray(state["base_key"])
+    last_metrics, offset = {}, 0
+    for size in chunk_sizes(len(batches), bk.get("max_chunk", 8)):
+        chunk = tuple(batches[offset : offset + size])
+        offset += size
+        carry, metrics = block(carry, chunk, base_key, start_count)
+        start_count += size
+        last_metrics = jax.device_get(metrics)
+    return {"metrics": last_metrics}

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.analysis.strict import strict_guard
+from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite, nan_scan, strict_enabled, strict_guard
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.sac.agent import SACActor
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss
@@ -32,7 +32,8 @@ from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
-from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
+from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.models.blocks import MLP
 from sheeprl_tpu.utils.env import make_vector_env
@@ -148,6 +149,8 @@ def main(ctx, cfg) -> None:
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
 
     tau, gamma, batch_size = cfg.algo.tau, cfg.algo.gamma, cfg.algo.per_rank_batch_size
+    health = health_enabled(cfg)  # trace-time constant (obs/health.py)
+    strict = strict_enabled(cfg)
 
     @jax.jit
     def act_fn(p, obs, key):
@@ -189,12 +192,26 @@ def main(ctx, cfg) -> None:
                     p["critic"],
                 ),
             }
-            return (p, {**o_state, "critic": new_c_state}, gstep), cl
+            step_metrics = {"Loss/value_loss": cl}
+            if health:
+                step_metrics.update(
+                    diagnostics(
+                        grads={"critic": grads},
+                        params=p,
+                        updates={"critic": updates},
+                        aux={"target_q_mean": target.mean()},
+                    )
+                )
+            return (p, {**o_state, "critic": new_c_state}, gstep), step_metrics
 
         g = batches["obs"].shape[0]
         batches["_key"] = jax.random.split(key, g)
-        (p, o_state, _), closses = jax.lax.scan(step, (p, o_state, grad_step0), batches)
-        return p, o_state, closses.mean()
+        (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, grad_step0), batches)
+        metrics = jax.tree.map(jnp.mean, metrics)
+        metrics = maybe_inject_nonfinite(cfg, metrics)
+        if strict:  # trace-time constant: the callback only exists in strict runs
+            nan_scan(metrics, "droq/train_critics_fn")
+        return p, o_state, metrics
 
     # analysis.strict: signature guard on the jitted critic update
     train_critics_fn = strict_guard(cfg, "droq/train_critics_fn", train_critics_fn)
@@ -219,7 +236,17 @@ def main(ctx, cfg) -> None:
         tl, t_grads = jax.value_and_grad(lambda la: alpha_loss(la, logp, target_entropy))(p["log_alpha"])
         t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
         p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
-        return p, {**o_state, "actor": new_a_state, "alpha": new_t_state}, al, tl
+        metrics = {"Loss/policy_loss": al, "Loss/alpha_loss": tl}
+        if health:
+            metrics.update(
+                diagnostics(
+                    grads={"actor": grads, "alpha": t_grads},
+                    params=p,
+                    updates={"actor": updates, "alpha": t_updates},
+                    aux={"policy_entropy": -logp.mean()},
+                )
+            )
+        return p, {**o_state, "actor": new_a_state, "alpha": new_t_state}, metrics
 
     train_actor_fn = strict_guard(cfg, "droq/train_actor_fn", train_actor_fn)
 
@@ -282,6 +309,8 @@ def main(ctx, cfg) -> None:
         prefetcher, rb_lock = None, contextlib.nullcontext()
     futures = WindowedFutures()
 
+    recorder = flight_recorder.get_active()
+
     def _dispatch_train(grad_steps: int, stage_next: bool) -> None:
         nonlocal params, opt_state, cumulative_grad_steps
         batches, actor_batch = (
@@ -289,18 +318,23 @@ def main(ctx, cfg) -> None:
             if prefetcher is not None
             else _sample_block(grad_steps)
         )
-        params, opt_state, c_loss_val = train_critics_fn(
-            params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+        key = ctx.rng()
+        if recorder is not None:  # device-array references only: no host sync
+            recorder.stage_step(
+                batch=batches,
+                actor_batch=actor_batch,
+                carry={"params": params, "opt_state": opt_state},
+                key=key,
+                scalars={"grad_step0": int(cumulative_grad_steps)},
+            )
+        params, opt_state, critic_metrics = train_critics_fn(
+            params, opt_state, batches, key, jnp.asarray(cumulative_grad_steps)
         )
-        params, opt_state, a_loss_val, t_loss_val = train_actor_fn(
+        params, opt_state, actor_metrics = train_actor_fn(
             params, opt_state, actor_batch, ctx.rng()
         )
         futures.track(
-            {
-                "Loss/value_loss": c_loss_val,
-                "Loss/policy_loss": a_loss_val,
-                "Loss/alpha_loss": t_loss_val,
-            },
+            {**critic_metrics, **actor_metrics},
             grad_steps,
         )
         cumulative_grad_steps += grad_steps
@@ -365,6 +399,7 @@ def main(ctx, cfg) -> None:
         ):
             futures.drain(aggregator)  # the window's only blocking device sync
             metrics = aggregator.compute()
+            metrics.update(replay_age_metrics(rb))
             window_sps = futures.pop_window_sps()
             if window_sps is not None:
                 metrics["Time/sps_train"] = window_sps

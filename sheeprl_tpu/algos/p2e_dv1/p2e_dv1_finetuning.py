@@ -24,6 +24,7 @@ from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_device_replay
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs.health import replay_age_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -274,6 +275,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
         ):
             dispatcher.drain(aggregator)  # the window's only blocking device sync
             metrics = aggregator.compute()
+            metrics.update(replay_age_metrics(rb))
             window_sps = dispatcher.pop_window_sps()
             if window_sps is not None:
                 metrics["Time/sps_train"] = window_sps

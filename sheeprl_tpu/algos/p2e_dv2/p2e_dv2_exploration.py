@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled
+from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite, nan_scan, strict_enabled
 from sheeprl_tpu.algos.dreamer_v2.agent import exploration_amount
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import make_buffer
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
@@ -50,6 +50,7 @@ from sheeprl_tpu.data.buffers import EpisodeBuffer
 from sheeprl_tpu.data.device_buffer import make_device_replay
 from sheeprl_tpu.distributions import BernoulliSafeMode, Independent, Normal, OneHotCategorical
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -326,6 +327,15 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
         metrics["Loss/value_loss_exploration"] = value_loss_expl
         metrics["Loss/policy_loss_task"] = policy_loss_task
         metrics["Loss/value_loss_task"] = value_loss_task
+        if health_enabled(cfg):  # trace-time constant (obs/health.py)
+            metrics.update(
+                diagnostics(
+                    grads={"world_model": wm_grads, "ensembles": ens_grads, "actor_exploration": expl_grads, "critic_exploration": ce_grads, "actor_task": task_grads, "critic_task": ct_grads},
+                    params=new_params,
+                    updates={"world_model": wm_updates, "ensembles": ens_updates, "actor_exploration": ae_updates, "critic_exploration": ce_updates, "actor_task": at_updates, "critic_task": ct_updates},
+                )
+            )
+        metrics = maybe_inject_nonfinite(cfg, metrics)
         if strict_enabled(cfg):  # trace-time constant: callback exists only in strict runs
             nan_scan(metrics, "p2e_dv2/train_step")
         return new_params, new_opt_states, metrics
@@ -562,6 +572,7 @@ def main(ctx, cfg) -> None:
         ):
             dispatcher.drain(aggregator)  # the window's only blocking device sync
             metrics = aggregator.compute()
+            metrics.update(replay_age_metrics(rb))
             window_sps = dispatcher.pop_window_sps()
             if window_sps is not None:
                 metrics["Time/sps_train"] = window_sps

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled
+from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite, nan_scan, strict_enabled
 from sheeprl_tpu.algos.dreamer_v3.agent import PlayerState, WorldModel, make_player_step
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.p2e import ensemble_loss, intrinsic_reward
@@ -57,6 +57,7 @@ from sheeprl_tpu.distributions import (
     TwoHotEncodingDistribution,
 )
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -406,6 +407,27 @@ def make_train_step(world_model, actor, critic, ensemble_mlp, cfg, cnn_keys, mlp
         metrics["Loss/policy_loss_exploration"] = policy_loss_expl
         metrics["Loss/policy_loss_task"] = policy_loss_task
         metrics["Loss/value_loss_task"] = value_loss_task
+        if health_enabled(cfg):  # trace-time constant (obs/health.py)
+            metrics.update(
+                diagnostics(
+                    grads={
+                        "world_model": wm_grads,
+                        "ensembles": ens_grads,
+                        "actor_exploration": expl_grads,
+                        "actor_task": task_grads,
+                        "critic_task": ct_grads,
+                    },
+                    params=new_params,
+                    updates={
+                        "world_model": wm_updates,
+                        "ensembles": ens_updates,
+                        "actor_exploration": ae_updates,
+                        "actor_task": at_updates,
+                        "critic_task": ct_updates,
+                    },
+                )
+            )
+        metrics = maybe_inject_nonfinite(cfg, metrics)
         if strict_enabled(cfg):  # trace-time constant: callback exists only in strict runs
             nan_scan(metrics, "p2e_dv3/train_step")
         return new_params, new_opt_states, new_moments, metrics
@@ -664,6 +686,7 @@ def main(ctx, cfg) -> None:
         ):
             dispatcher.drain(aggregator)  # the window's only blocking device sync
             metrics = aggregator.compute()
+            metrics.update(replay_age_metrics(rb))
             window_sps = dispatcher.pop_window_sps()
             if window_sps is not None:
                 metrics["Time/sps_train"] = window_sps

@@ -26,7 +26,13 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.analysis.strict import assert_finite, nan_scan, strict_enabled, strict_guard
+from sheeprl_tpu.analysis.strict import (
+    assert_finite,
+    maybe_inject_nonfinite,
+    nan_scan,
+    strict_enabled,
+    strict_guard,
+)
 from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import (
@@ -39,7 +45,8 @@ from sheeprl_tpu.algos.ppo.utils import (
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
+from sheeprl_tpu.obs.health import diagnostics, health_enabled
 from sheeprl_tpu.rollout import PipelinedPlayer, rollout_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -113,6 +120,7 @@ class PPOTrainFns:
         num_minibatches = self.num_minibatches
         opt = self.opt
         strict = strict_enabled(cfg)
+        health = health_enabled(cfg)  # trace-time constant (obs/health.py)
 
         @jax.jit
         def act_fn(p, obs, key):
@@ -137,7 +145,12 @@ class PPOTrainFns:
             )
             ent = entropy_loss(entropy, loss_reduction)
             total = pg + cfg.algo.vf_coef * vf + ent_coef * ent
-            return total, {"Loss/policy_loss": pg, "Loss/value_loss": vf, "Loss/entropy_loss": -ent}
+            aux = {"Loss/policy_loss": pg, "Loss/value_loss": vf, "Loss/entropy_loss": -ent}
+            if health:
+                aux["Health/policy_entropy"] = entropy.mean()
+                aux["Health/value_mean"] = new_values.mean()
+                aux["Health/value_std"] = new_values.std()
+            return total, aux
 
         @jax.jit
         def train_fn(p, o_state, data, key, clip_coef, ent_coef):
@@ -149,6 +162,8 @@ class PPOTrainFns:
                 (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, mb, clip_coef, ent_coef)
                 updates, o_state = opt.update(grads, o_state, p)
                 p = optax.apply_updates(p, updates)
+                if health:  # per-module norms/ratios, averaged by the scans below
+                    aux = {**aux, **diagnostics(grads=grads, params=p, updates=updates)}
                 return (p, o_state), aux
 
             def epoch_step(carry, ekey):
@@ -160,6 +175,7 @@ class PPOTrainFns:
             keys = jax.random.split(key, cfg.algo.update_epochs)
             (p, o_state), metrics = jax.lax.scan(epoch_step, (p, o_state), keys)
             metrics = jax.tree.map(jnp.mean, metrics)
+            metrics = maybe_inject_nonfinite(cfg, metrics)
             if strict:  # trace-time constant: the callback only exists in strict runs
                 nan_scan(metrics, "ppo/train_fn")
             return p, o_state, metrics
@@ -225,6 +241,17 @@ def main(ctx, cfg) -> None:
     train_fn = strict_guard(cfg, "ppo/train_fn", train_fn)
     gamma = cfg.algo.gamma
 
+    # Flight recorder (obs/flight_recorder.py): the replay builder rebuilds this
+    # exact update from the dumped config + these statics.
+    recorder = flight_recorder.get_active()
+    if recorder is not None:
+        recorder.arm_replay(
+            "sheeprl_tpu.algos.ppo.ppo:replay_update",
+            act_space=act_space,
+            obs_space=obs_space,
+            num_updates=num_updates,
+        )
+
     # ------------------------------------------------------------------ resume
     start_update = 1
     policy_step = 0
@@ -275,8 +302,10 @@ def main(ctx, cfg) -> None:
         env_time_start = time.perf_counter()
         with timer("Time/env_interaction_time"):
             for _ in range(rollout_steps):
-                env_actions, (env_act_np, logprob_np, value_np) = rollout_player.act(obs)
-                next_obs, reward, terminated, truncated, info = rollout_player.env_step(env_actions)
+                with monitor.phase("player"):
+                    env_actions, (env_act_np, logprob_np, value_np) = rollout_player.act(obs)
+                with monitor.phase("env_step"):
+                    next_obs, reward, terminated, truncated, info = rollout_player.env_step(env_actions)
                 if cfg.env.clip_rewards:
                     reward = np.clip(reward, -1, 1)
                 done = np.logical_or(terminated, truncated)
@@ -302,7 +331,8 @@ def main(ctx, cfg) -> None:
                 step_data["values"] = value_np.reshape(num_envs, 1)[None]
                 step_data["rewards"] = reward.reshape(num_envs, 1)[None]
                 step_data["dones"] = done.astype(np.float32).reshape(num_envs, 1)[None]
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                with monitor.phase("buffer_add"):
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
                 obs = next_obs
                 policy_step += num_envs * world
@@ -332,9 +362,19 @@ def main(ctx, cfg) -> None:
         if cfg.algo.anneal_ent_coef:
             ent_coef = polynomial_decay(update, initial=ent_coef, final=0.0, max_decay_steps=num_updates)
 
-        with timer("Time/train_time"):
+        # Stage this update's exact inputs on the flight recorder: device-array
+        # references only (no sync, no copy) — fetched solely if the run crashes.
+        key = ctx.rng()
+        if recorder is not None:
+            recorder.stage_step(
+                batch=data,
+                carry={"params": params, "opt_state": opt_state},
+                key=key,
+                scalars={"clip_coef": float(clip_coef), "ent_coef": float(ent_coef), "update": update},
+            )
+        with timer("Time/train_time"), monitor.phase("dispatch"):
             t0 = time.perf_counter()
-            params, opt_state, train_metrics = train_fn(params, opt_state, data, ctx.rng(), clip_coef, ent_coef)
+            params, opt_state, train_metrics = train_fn(params, opt_state, data, key, clip_coef, ent_coef)
             train_metrics = jax.device_get(train_metrics)
             train_time = time.perf_counter() - t0
         assert_finite(cfg, train_metrics, "ppo/update")
@@ -363,17 +403,18 @@ def main(ctx, cfg) -> None:
             or update == num_updates
             and cfg.checkpoint.save_last
         ):
-            ckpt_manager.save(
-                policy_step,
-                {
-                    "params": params,
-                    "opt_state": opt_state,
-                    "update": update,
-                    "policy_step": policy_step,
-                    "last_log": last_log,
-                    "last_checkpoint": policy_step,
-                },
-            )
+            with monitor.phase("checkpoint"):
+                ckpt_manager.save(
+                    policy_step,
+                    {
+                        "params": params,
+                        "opt_state": opt_state,
+                        "update": update,
+                        "policy_step": policy_step,
+                        "last_log": last_log,
+                        "last_checkpoint": policy_step,
+                    },
+                )
             last_checkpoint = policy_step
 
     monitor.close()
@@ -388,3 +429,35 @@ def main(ctx, cfg) -> None:
         maybe_register_models(cfg, log_dir)
     if logger is not None:
         logger.close()
+
+
+def replay_update(cfg, dump_dir):
+    """Flight-recorder replay builder (``python -m sheeprl_tpu.obs.replay_blackbox``):
+    rebuild the PPO jitted update from a blackbox dump's config + statics, restore
+    the dumped params/optimizer state/batch, and re-execute the single failing
+    update step.  Shared by the coupled and decoupled entry points (same
+    ``PPOTrainFns.train_fn``).  Returns the update's host-fetched outputs."""
+    from sheeprl_tpu.obs import replay_blackbox
+    from sheeprl_tpu.parallel.mesh import make_mesh_context
+
+    ctx = make_mesh_context(cfg)
+    raw = replay_blackbox.load_state(dump_dir)
+    statics = raw["statics"]
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+    agent, params0 = build_agent(ctx, statics["act_space"], statics["obs_space"], cfg)
+    fns = PPOTrainFns(ctx, agent, cfg, obs_keys, statics["num_updates"])
+    templates = {"carry": jax.device_get({"params": params0, "opt_state": fns.opt.init(params0)})}
+    state = replay_blackbox.load_state(dump_dir, templates)
+    carry, scalars = state["carry"], state["scalars"]
+    new_params, _, metrics = fns.train_fn(
+        ctx.replicate(carry["params"]),
+        ctx.replicate(carry["opt_state"]),
+        state["batch"],
+        jnp.asarray(state["key"]),
+        scalars["clip_coef"],
+        scalars["ent_coef"],
+    )
+    return {
+        "metrics": jax.device_get(metrics),
+        "new_param_norm": float(jax.device_get(optax.global_norm(new_params))),
+    }

@@ -86,6 +86,19 @@ def main(ctx, cfg) -> None:
     train_fn = strict_guard(cfg, "ppo_decoupled/train_fn", train_fn)
     gamma = cfg.algo.gamma
 
+    # Flight recorder: the coupled entry point's replay builder rebuilds this same
+    # PPOTrainFns.train_fn, so decoupled dumps replay through it too.
+    from sheeprl_tpu.obs import flight_recorder
+
+    recorder = flight_recorder.get_active()
+    if recorder is not None:
+        recorder.arm_replay(
+            "sheeprl_tpu.algos.ppo.ppo:replay_update",
+            act_space=act_space,
+            obs_space=obs_space,
+            num_updates=num_updates,
+        )
+
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     # The aggregator is written by the player (episode stats) and read/reset by the
@@ -234,9 +247,17 @@ def main(ctx, cfg) -> None:
             if cfg.algo.anneal_ent_coef:
                 ent_coef = polynomial_decay(update, initial=ent_coef, final=0.0, max_decay_steps=num_updates)
 
-            with timer("Time/train_time"):
+            key = ctx.rng()
+            if recorder is not None:  # device-array references only: no host sync
+                recorder.stage_step(
+                    batch=data,
+                    carry={"params": params, "opt_state": opt_state},
+                    key=key,
+                    scalars={"clip_coef": float(clip_coef), "ent_coef": float(ent_coef), "update": update},
+                )
+            with timer("Time/train_time"), monitor.phase("dispatch"):
                 t0 = time.perf_counter()
-                params, opt_state, train_metrics = train_fn(params, opt_state, data, ctx.rng(), clip_coef, ent_coef)
+                params, opt_state, train_metrics = train_fn(params, opt_state, data, key, clip_coef, ent_coef)
                 # Publish the (asynchronously dispatched) params immediately — the
                 # player's next rollout overlaps this update's device execution.
                 param_q.put(params)

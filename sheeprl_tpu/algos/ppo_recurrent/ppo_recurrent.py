@@ -21,11 +21,12 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, log_prob_and_entropy, prepare_obs, sample_actions
 from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, build_agent, make_zero_state
-from sheeprl_tpu.analysis.strict import assert_finite, strict_guard
+from sheeprl_tpu.analysis.strict import assert_finite, maybe_inject_nonfinite, strict_guard
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
+from sheeprl_tpu.obs.health import diagnostics, health_enabled
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -98,6 +99,7 @@ def main(ctx, cfg) -> None:
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
 
     gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
+    health = health_enabled(cfg)  # trace-time constant (obs/health.py)
 
     @jax.jit
     def act_fn(p, obs, prev_actions, is_first, state, key):
@@ -125,7 +127,11 @@ def main(ctx, cfg) -> None:
         vf = value_loss(values[..., 0], batch["values"], batch["returns"], clip_coef, cfg.algo.clip_vloss, "mean")
         ent = entropy_loss(entropy, cfg.algo.loss_reduction)
         total = pg + cfg.algo.vf_coef * vf + cfg.algo.ent_coef * ent
-        return total, {"Loss/policy_loss": pg, "Loss/value_loss": vf, "Loss/entropy_loss": -ent}
+        aux = {"Loss/policy_loss": pg, "Loss/value_loss": vf, "Loss/entropy_loss": -ent}
+        if health:
+            aux["Health/policy_entropy"] = entropy.mean()
+            aux["Health/value_mean"] = values.mean()
+        return total, aux
 
     # Shard each [T, mb_envs, ...] minibatch over the data axis (same pattern as
     # ppo.py:134,171) so gradient computation is data-parallel under GSPMD.
@@ -143,7 +149,10 @@ def main(ctx, cfg) -> None:
             batch["h0"] = h0[env_idx]
             (_, aux), grads = jax.value_and_grad(seq_loss_fn, has_aux=True)(p, batch, clip_coef, ent_coef)
             updates, o_state = opt.update(grads, o_state, p)
-            return (optax.apply_updates(p, updates), o_state), aux
+            p = optax.apply_updates(p, updates)
+            if health:  # per-module norms/ratios, averaged by the scans below
+                aux = {**aux, **diagnostics(grads=grads, params=p, updates=updates)}
+            return (p, o_state), aux
 
         def epoch_step(carry, ekey):
             perm = jax.random.permutation(ekey, num_envs).reshape(num_batches, mb_envs)
@@ -152,10 +161,15 @@ def main(ctx, cfg) -> None:
 
         keys = jax.random.split(key, cfg.algo.update_epochs)
         (p, o_state), metrics = jax.lax.scan(epoch_step, (p, o_state), keys)
-        return p, o_state, jax.tree.map(jnp.mean, metrics)
+        metrics = jax.tree.map(jnp.mean, metrics)
+        return p, o_state, maybe_inject_nonfinite(cfg, metrics)
 
     # analysis.strict: signature guard on the jitted update (drift -> hard error)
     train_fn = strict_guard(cfg, "ppo_recurrent/train_fn", train_fn)
+
+    # Flight recorder: no replay builder for the recurrent update yet — staging
+    # still dumps the offending batch + state for forensics.
+    recorder = flight_recorder.get_active()
 
     start_update, policy_step, last_log, last_checkpoint = 1, 0, 0, 0
     if cfg.checkpoint.get("resume_from"):
@@ -269,10 +283,18 @@ def main(ctx, cfg) -> None:
         if cfg.algo.anneal_ent_coef:
             ent_coef = polynomial_decay(update, initial=ent_coef, final=0.0, max_decay_steps=num_updates)
 
-        with timer("Time/train_time"):
+        key = ctx.rng()
+        if recorder is not None:  # device-array references only: no host sync
+            recorder.stage_step(
+                batch=seq_data,
+                carry={"params": params, "opt_state": opt_state, "c0": c0, "h0": h0},
+                key=key,
+                scalars={"clip_coef": float(clip_coef), "ent_coef": float(ent_coef), "update": update},
+            )
+        with timer("Time/train_time"), monitor.phase("dispatch"):
             t0 = time.perf_counter()
             params, opt_state, train_metrics = train_fn(
-                params, opt_state, seq_data, c0, h0, ctx.rng(), clip_coef, ent_coef
+                params, opt_state, seq_data, c0, h0, key, clip_coef, ent_coef
             )
             train_metrics = jax.device_get(train_metrics)
             train_time = time.perf_counter() - t0

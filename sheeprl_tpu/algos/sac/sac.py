@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled, strict_guard
+from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite, nan_scan, strict_enabled, strict_guard
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.sac.agent import build_agent
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
@@ -29,7 +29,8 @@ from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
-from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
+from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.rollout import PipelinedPlayer, rollout_metrics
 from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
@@ -49,6 +50,7 @@ def make_sac_train_fn(actor, critic, cfg, act_space):
     gamma = cfg.algo.gamma
 
     strict = strict_enabled(cfg)
+    health = health_enabled(cfg)  # trace-time constant (obs/health.py)
     actor_opt = make_optimizer(cfg.algo.actor.optimizer, cfg.algo.get("max_grad_norm", 0.0))
     critic_opt = make_optimizer(cfg.algo.critic.optimizer, cfg.algo.get("max_grad_norm", 0.0))
     alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
@@ -74,7 +76,7 @@ def make_sac_train_fn(actor, critic, cfg, act_space):
 
         def c_loss(cp):
             qs = critic.apply(cp, obs, action)
-            return critic_loss(qs, target)
+            return critic_loss(qs, target), {"q_mean": qs.mean(), "q_std": qs.std(), "target_q_mean": target.mean()}
 
         # --- actor (reference sac.py:50-58); takes the critic params explicitly so the
         # caller can pass the POST-update critic (reference updates critic first).
@@ -99,7 +101,7 @@ def make_sac_train_fn(actor, critic, cfg, act_space):
             p, o_state, gstep = carry
             c_loss, a_loss, t_loss = _losses(p, batch, batch.pop("_key"))
 
-            cl, c_grads = jax.value_and_grad(c_loss)(p["critic"])
+            (cl, q_aux), c_grads = jax.value_and_grad(c_loss, has_aux=True)(p["critic"])
             c_updates, new_c_state = critic_opt.update(c_grads, o_state["critic"], p["critic"])
             p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
 
@@ -125,12 +127,23 @@ def make_sac_train_fn(actor, critic, cfg, act_space):
                 ),
             }
             o_state = {"actor": new_a_state, "critic": new_c_state, "alpha": new_t_state}
-            return (p, o_state, gstep), {"Loss/value_loss": cl, "Loss/policy_loss": al, "Loss/alpha_loss": tl}
+            metrics = {"Loss/value_loss": cl, "Loss/policy_loss": al, "Loss/alpha_loss": tl}
+            if health:  # per-module norms/ratios + entropy/Q stats, one scalar tree
+                metrics.update(
+                    diagnostics(
+                        grads={"critic": c_grads, "actor": a_grads, "alpha": t_grads},
+                        params=p,
+                        updates={"critic": c_updates, "actor": a_updates, "alpha": t_updates},
+                        aux={"policy_entropy": -logp.mean(), **q_aux},
+                    )
+                )
+            return (p, o_state, gstep), metrics
 
         g = batches["obs"].shape[0]
         batches["_key"] = jax.random.split(key, g)
         (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, grad_step0), batches)
         metrics = jax.tree.map(jnp.mean, metrics)
+        metrics = maybe_inject_nonfinite(cfg, metrics)
         if strict:  # trace-time constant: the callback only exists in strict runs
             nan_scan(metrics, "sac/train_fn")
         return p, o_state, metrics
@@ -157,6 +170,13 @@ def main(ctx, cfg) -> None:
     actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
     actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
     train_fn = strict_guard(cfg, "sac/train_fn", train_fn)
+    recorder = flight_recorder.get_active()
+    if recorder is not None:
+        recorder.arm_replay(
+            "sheeprl_tpu.algos.sac.sac:replay_update",
+            act_space=act_space,
+            obs_space=obs_space,
+        )
     opt_state = ctx.replicate(
         {
             "actor": actor_opt.init(params["actor"]),
@@ -272,8 +292,16 @@ def main(ctx, cfg) -> None:
             if prefetcher is not None
             else _sample_block(grad_steps)
         )
+        key = ctx.rng()
+        if recorder is not None:  # device-array references only: no host sync
+            recorder.stage_step(
+                batch=batches,
+                carry={"params": params, "opt_state": opt_state},
+                key=key,
+                scalars={"grad_step0": int(cumulative_grad_steps)},
+            )
         params, opt_state, train_metrics = train_fn(
-            params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+            params, opt_state, batches, key, jnp.asarray(cumulative_grad_steps)
         )
         futures.track(train_metrics, grad_steps)
         cumulative_grad_steps += grad_steps
@@ -290,7 +318,8 @@ def main(ctx, cfg) -> None:
                     2 * (actions - act_low) / (act_high - act_low) - 1 if rescale else actions
                 )
             else:
-                actions, tanh_actions = rollout_player.act(obs)
+                with monitor.phase("player"):
+                    actions, tanh_actions = rollout_player.act(obs)
         env_time = time.perf_counter() - env_t0
 
         # Dispatch this iteration's gradient block BEFORE stepping the envs so the
@@ -310,11 +339,13 @@ def main(ctx, cfg) -> None:
                 if rb.empty:
                     deferred_dispatch = True
                 else:
-                    _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
+                    with monitor.phase("dispatch"):
+                        _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
 
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
-            next_obs, reward, terminated, truncated, info = rollout_player.env_step(actions)
+            with monitor.phase("env_step"):
+                next_obs, reward, terminated, truncated, info = rollout_player.env_step(actions)
             done = np.logical_or(terminated, truncated)
 
             # Store the TRUE next observation for done envs (SAME_STEP autoreset
@@ -333,7 +364,7 @@ def main(ctx, cfg) -> None:
             step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
             # Truncated episodes still bootstrap (done=0 in the TD target).
             step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
-            with rb_lock:
+            with monitor.phase("buffer_add"), rb_lock:
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
             obs = next_obs
             policy_step += policy_steps_per_iter
@@ -341,7 +372,8 @@ def main(ctx, cfg) -> None:
         env_time += time.perf_counter() - env_t0
 
         if deferred_dispatch:
-            _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
+            with monitor.phase("dispatch"):
+                _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
 
         if logger is not None and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
@@ -355,6 +387,7 @@ def main(ctx, cfg) -> None:
             metrics["Params/replay_ratio"] = (
                 cumulative_grad_steps * world / policy_step if policy_step > 0 else 0.0
             )
+            metrics.update(replay_age_metrics(rb))
             metrics.update(rollout_metrics(envs))
             monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()
@@ -376,9 +409,10 @@ def main(ctx, cfg) -> None:
                 "last_checkpoint": policy_step,
                 "cumulative_grad_steps": cumulative_grad_steps,
             }
-            if cfg.buffer.checkpoint:
-                state["rb"] = rb.state_dict()
-            ckpt_manager.save(policy_step, state)
+            with monitor.phase("checkpoint"):
+                if cfg.buffer.checkpoint:
+                    state["rb"] = rb.state_dict()
+                ckpt_manager.save(policy_step, state)
             last_checkpoint = policy_step
 
     monitor.close()
@@ -395,3 +429,36 @@ def main(ctx, cfg) -> None:
         maybe_register_models(cfg, log_dir)
     if logger is not None:
         logger.close()
+
+
+def replay_update(cfg, dump_dir):
+    """Flight-recorder replay builder: re-execute the dumped SAC gradient block on
+    CPU.  Shared by the coupled and decoupled entry points (same
+    ``make_sac_train_fn`` update)."""
+    from sheeprl_tpu.obs import replay_blackbox
+    from sheeprl_tpu.parallel.mesh import make_mesh_context
+
+    ctx = make_mesh_context(cfg)
+    raw = replay_blackbox.load_state(dump_dir)
+    statics = raw["statics"]
+    actor, critic, params0 = build_agent(ctx, statics["act_space"], statics["obs_space"], cfg)
+    actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, statics["act_space"])
+    opt0 = {
+        "actor": actor_opt.init(params0["actor"]),
+        "critic": critic_opt.init(params0["critic"]),
+        "alpha": alpha_opt.init(params0["log_alpha"]),
+    }
+    templates = {"carry": jax.device_get({"params": params0, "opt_state": opt0})}
+    state = replay_blackbox.load_state(dump_dir, templates)
+    carry = state["carry"]
+    new_params, _, metrics = train_fn(
+        ctx.replicate(carry["params"]),
+        ctx.replicate(carry["opt_state"]),
+        state["batch"],
+        jnp.asarray(state["key"]),
+        jnp.asarray(state["scalars"]["grad_step0"]),
+    )
+    return {
+        "metrics": jax.device_get(metrics),
+        "new_param_norm": float(jax.device_get(optax.global_norm(new_params))),
+    }

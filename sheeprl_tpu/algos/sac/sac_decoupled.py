@@ -61,6 +61,17 @@ def main(ctx, cfg) -> None:
     actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
     actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
     train_fn = strict_guard(cfg, "sac_decoupled/train_fn", train_fn)
+    # Flight recorder: decoupled dumps replay through the coupled builder (same
+    # make_sac_train_fn update).
+    from sheeprl_tpu.obs import flight_recorder
+
+    recorder = flight_recorder.get_active()
+    if recorder is not None:
+        recorder.arm_replay(
+            "sheeprl_tpu.algos.sac.sac:replay_update",
+            act_space=act_space,
+            obs_space=obs_space,
+        )
     opt_state = ctx.replicate(
         {
             "actor": actor_opt.init(params["actor"]),
@@ -247,10 +258,18 @@ def main(ctx, cfg) -> None:
             train_time = 0.0
             if grad_steps > 0:
                 batches = ctx.put_batch(item["batches"], batch_axis=1)
-                with timer("Time/train_time"):
+                key = ctx.rng()
+                if recorder is not None:  # device-array references only: no host sync
+                    recorder.stage_step(
+                        batch=batches,
+                        carry={"params": params, "opt_state": opt_state},
+                        key=key,
+                        scalars={"grad_step0": int(cumulative_grad_steps)},
+                    )
+                with timer("Time/train_time"), monitor.phase("dispatch"):
                     t0 = time.perf_counter()
                     params, opt_state, train_metrics = train_fn(
-                        params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+                        params, opt_state, batches, key, jnp.asarray(cumulative_grad_steps)
                     )
                     # Publish the (asynchronously dispatched) params immediately;
                     # drop stale entries — the player only wants the latest.

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.analysis.strict import nan_scan, strict_enabled, strict_guard
+from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite, nan_scan, strict_enabled, strict_guard
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
 from sheeprl_tpu.algos.sac_ae.agent import build_agent, preprocess_obs
@@ -30,7 +30,8 @@ from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import DeviceReplayMirror, device_replay_enabled
 from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
-from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
+from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.rollout import rollout_metrics
 from sheeprl_tpu.utils.blocks import WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
@@ -124,6 +125,7 @@ def main(ctx, cfg) -> None:
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
 
     gamma = cfg.algo.gamma
+    health = health_enabled(cfg)  # trace-time constant (obs/health.py)
     batch_size = cfg.algo.per_rank_batch_size
     critic_tau = cfg.algo.critic.tau
     encoder_tau = cfg.algo.encoder.tau
@@ -251,12 +253,24 @@ def main(ctx, cfg) -> None:
                 "Loss/alpha_loss": tl,
                 "Loss/reconstruction_loss": rl,
             }
+            if health:
+                # Critic-path grads/updates are unconditional; the actor/decoder
+                # branches live inside lax.cond and keep their own cadence.
+                metrics.update(
+                    diagnostics(
+                        grads={"critic": c_grads},
+                        params=p,
+                        updates={"critic": c_updates},
+                        aux={"target_q_mean": target.mean()},
+                    )
+                )
             return (p, o_state, gstep + 1), metrics
 
         g = batches["obs"].shape[0]
         batches["_key"] = jax.random.split(key, g)
         (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, step0), batches)
         metrics = jax.tree.map(jnp.mean, metrics)
+        metrics = maybe_inject_nonfinite(cfg, metrics)
         if strict_enabled(cfg):  # trace-time constant
             nan_scan(metrics, "sac_ae/train_fn")
         return p, o_state, metrics
@@ -347,10 +361,18 @@ def main(ctx, cfg) -> None:
         batches = transition_gather(mirror_arrays, idxs, envs_i)
         return train_fn(p, o_state, batches, key, step0)
 
+    recorder = flight_recorder.get_active()
+
     def _dispatch_train(grad_steps: int, stage_next: bool) -> None:
         nonlocal params, opt_state, cumulative_grad_steps
         if mirror is not None:
             idxs, envs_i = rb.sample_transition_idx(batch_size, grad_steps)
+            if recorder is not None:  # indices only on the mirror path (the ring
+                # itself is donated per scatter, so row refs cannot be staged)
+                recorder.stage_step(
+                    carry={"params": params, "opt_state": opt_state},
+                    scalars={"grad_step0": int(cumulative_grad_steps), "idxs": idxs.tolist(), "envs": envs_i.tolist()},
+                )
             params, opt_state, train_metrics = train_fn_indexed(
                 params,
                 opt_state,
@@ -366,8 +388,16 @@ def main(ctx, cfg) -> None:
                 if prefetcher is not None
                 else _sample_block(grad_steps)
             )
+            key = ctx.rng()
+            if recorder is not None:  # device-array references only: no host sync
+                recorder.stage_step(
+                    batch=batches,
+                    carry={"params": params, "opt_state": opt_state},
+                    key=key,
+                    scalars={"grad_step0": int(cumulative_grad_steps)},
+                )
             params, opt_state, train_metrics = train_fn(
-                params, opt_state, batches, ctx.rng(), jnp.asarray(cumulative_grad_steps)
+                params, opt_state, batches, key, jnp.asarray(cumulative_grad_steps)
             )
         futures.track(train_metrics, grad_steps)
         cumulative_grad_steps += grad_steps
@@ -450,6 +480,7 @@ def main(ctx, cfg) -> None:
                 metrics["Time/sps_train"] = window_sps
             metrics["Time/sps_env_interaction"] = policy_steps_per_iter / world / env_time if env_time > 0 else 0.0
             metrics["Params/replay_ratio"] = cumulative_grad_steps * world / policy_step if policy_step else 0.0
+            metrics.update(replay_age_metrics(rb))
             metrics.update(rollout_metrics(envs))
             monitor.log_metrics(logger, metrics, policy_step)
             aggregator.reset()

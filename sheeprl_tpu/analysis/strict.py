@@ -93,6 +93,9 @@ def strict_guard(cfg: Any, name: str, fn: Callable) -> Callable:
             recorded["sig"] = sig
         elif sig != first:
             diff = _describe_drift(first, sig)
+            from sheeprl_tpu.obs import flight_recorder
+
+            flight_recorder.record_event("signature_drift", entry_point=name, diff=diff)
             raise SignatureDriftError(
                 f"analysis.strict: jit entry point '{name}' called with a drifting signature "
                 f"({diff}); this would silently recompile every time it changes. Pad/bucket the "
@@ -117,6 +120,46 @@ def _describe_drift(first: Tuple, now: Tuple) -> str:
 
 def registered_guards() -> Dict[str, Callable]:
     return dict(_registered_guards)
+
+
+# --------------------------------------------------------------- fault injection
+def inject_nonfinite_enabled(cfg: Any) -> bool:
+    """True iff ``cfg.analysis.inject_nan`` is set — the flight-recorder e2e /
+    chaos-drill knob (tolerates dicts/DotDicts/None)."""
+    if cfg is None:
+        return False
+    try:
+        analysis = cfg.get("analysis") if hasattr(cfg, "get") else getattr(cfg, "analysis", None)
+    except Exception:
+        return False
+    if not analysis:
+        return False
+    try:
+        return bool(
+            analysis.get("inject_nan", False)
+            if hasattr(analysis, "get")
+            else getattr(analysis, "inject_nan", False)
+        )
+    except Exception:
+        return False
+
+
+def maybe_inject_nonfinite(cfg: Any, metrics: Dict[str, Any]) -> Dict[str, Any]:
+    """Poison one metric leaf with NaN when ``analysis.inject_nan`` is on.
+
+    Called inside jitted updates (via ``obs.health.health_metrics``); the gate is a
+    trace-time constant, so production runs compile no trace of it.  The injected
+    leaf crosses the update boundary like any real NaN: strict mode trips
+    ``assert_finite``/``nan_scan``, the flight recorder dumps, and — because the
+    dumped config carries the flag — ``replay_blackbox`` reproduces it.
+    """
+    if not inject_nonfinite_enabled(cfg):
+        return metrics
+    import jax.numpy as jnp
+
+    metrics = dict(metrics)
+    metrics["Health/inject_nan"] = jnp.float32(jnp.nan)
+    return metrics
 
 
 # --------------------------------------------------------------------- NaN/Inf scan
@@ -161,6 +204,9 @@ def raise_pending() -> None:
     with _pending_lock:
         hits, _pending_nonfinite[:] = list(_pending_nonfinite), []
     if hits:
+        from sheeprl_tpu.obs import flight_recorder
+
+        flight_recorder.record_event("nonfinite", labels=sorted(set(hits)))
         raise NonFiniteError(
             f"analysis.strict: non-finite values crossed the update boundary: {sorted(set(hits))}"
         )
@@ -189,4 +235,7 @@ def assert_finite(cfg: Any, tree: Any, label: str) -> None:
         if not np.isfinite(arr).all():
             bad.append(f"{label}{jax.tree_util.keystr(path)}")
     if bad:
+        from sheeprl_tpu.obs import flight_recorder
+
+        flight_recorder.record_event("nonfinite", labels=bad)
         raise NonFiniteError(f"analysis.strict: non-finite values at the update boundary: {bad}")

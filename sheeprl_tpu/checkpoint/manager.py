@@ -19,14 +19,18 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 import jax
+import numpy as np
 from flax import serialization
 
 PROTECTED_RESUME_KEYS = ("env", "algo", "buffer", "checkpoint", "distribution", "exp_name", "seed")
 
 
 def _is_device_tree(value: Any) -> bool:
+    # Leaves must be actual arrays, not merely dtype-carrying objects: gymnasium
+    # spaces expose .dtype too, and a statics dict of spaces (flight-recorder
+    # dumps) must take the pickle path, not msgpack.
     leaves = jax.tree.leaves(value)
-    return len(leaves) > 0 and all(hasattr(leaf, "dtype") for leaf in leaves)
+    return len(leaves) > 0 and all(isinstance(leaf, (np.ndarray, np.generic, jax.Array)) for leaf in leaves)
 
 
 class CheckpointManager:
@@ -47,16 +51,23 @@ class CheckpointManager:
 
             multihost_utils.sync_global_devices(name)
 
-    def save(self, step: int, state: Dict[str, Any]) -> Path:
+    def save(self, step: int, state: Dict[str, Any], sync: bool = True) -> Path:
         """``state`` maps names to either device pytrees or picklable host objects.
         Entries named in ``PER_RANK_KEYS`` are written by every process
         (``<name>.rank<k>.pkl``); everything else by process 0 only.
 
         Multi-host protocol: rank 0 builds the directory and atomically renames it
         into place, a global barrier publishes it, THEN the other ranks drop their
-        shards in — no writer ever races the rename."""
+        shards in — no writer ever races the rename.
+
+        ``sync=False`` is the crash-dump mode (``obs/flight_recorder.py``): no
+        barriers, rank 0 writes everything it has and non-zero ranks write nothing —
+        a post-mortem dump must never wait on peer processes that may already be
+        dead."""
         out = self.ckpt_dir / f"ckpt_{step}"
         rank = jax.process_index()
+        if rank != 0 and not sync:
+            return out
         if rank != 0:
             per_rank = {k: v for k, v in state.items() if k in self.PER_RANK_KEYS}
             self._barrier(f"ckpt_{step}_published")  # rank 0 has renamed tmp -> out
@@ -91,8 +102,9 @@ class CheckpointManager:
         if out.exists():
             shutil.rmtree(out)
         tmp.rename(out)
-        self._barrier(f"ckpt_{step}_published")
-        self._barrier(f"ckpt_{step}_shards")  # all ranks' shards are on disk
+        if sync:
+            self._barrier(f"ckpt_{step}_published")
+            self._barrier(f"ckpt_{step}_shards")  # all ranks' shards are on disk
         self._gc()
         return out
 

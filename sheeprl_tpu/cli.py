@@ -174,7 +174,23 @@ def run_algorithm(cfg: DotDict) -> None:
         timer.disabled = True
     MetricAggregator.disabled = cfg.metric.get("log_level", 1) == 0
 
-    entry["entrypoint"](ctx, cfg, **kwargs)
+    # Flight-recorder crash boundary (sheeprl_tpu/obs/flight_recorder.py): any
+    # exception escaping the algorithm — including strict-mode NonFiniteError/
+    # SignatureDriftError/RecompileError and RolloutAbortError — dumps the black
+    # box (<log_dir>/blackbox/) before propagating.  The recorder is installed by
+    # the entry point's TrainingMonitor and cleared here so back-to-back runs in
+    # one process never cross-contaminate.
+    from sheeprl_tpu.obs import flight_recorder
+
+    try:
+        entry["entrypoint"](ctx, cfg, **kwargs)
+    except Exception as exc:
+        dump = flight_recorder.dump_active("crash", exc)
+        if dump:
+            print(f"flight recorder: black box dumped to {dump}", file=sys.stderr)
+        raise
+    finally:
+        flight_recorder.install(None)
 
 
 def eval_algorithm(cfg: DotDict) -> None:

@@ -75,6 +75,13 @@ class ReplayBuffer:
         self._pos = 0
         self._full = False
         self._rng = np.random.default_rng()
+        # Replay staleness bookkeeping (obs/health.py Health/replay_age_* gauges):
+        # per-row write stamps in cumulative added-row units.  Host-side integers
+        # only — sampling records the most recent batch's age stats, never touching
+        # the device.
+        self._stamps = np.zeros(buffer_size, np.int64)
+        self._rows_added = 0
+        self._last_sample_ages: Optional[Tuple[float, float]] = None
 
     # -- properties ---------------------------------------------------------
     @property
@@ -149,13 +156,34 @@ class ReplayBuffer:
             idxes = (self._pos + np.arange(steps)) % self._buffer_size
             buf[idxes] = v
         if steps >= self._buffer_size:
+            self._stamps[:] = self._rows_added + steps - self._buffer_size + np.arange(self._buffer_size)
+            self._rows_added += steps
             self._pos = 0
             self._full = True
         else:
+            self._stamps[(self._pos + np.arange(steps)) % self._buffer_size] = self._rows_added + np.arange(steps)
+            self._rows_added += steps
             new_pos = self._pos + steps
             if new_pos >= self._buffer_size:
                 self._full = True
             self._pos = new_pos % self._buffer_size
+
+    # -- staleness ----------------------------------------------------------
+    def _note_sample_ages(self, rows: np.ndarray) -> None:
+        """Record the age distribution of the rows just sampled.  Age = rows added
+        to this buffer since the sampled row was written (0 = freshest possible)."""
+        if self._rows_added == 0:
+            return
+        ages = (self._rows_added - 1) - self._stamps[np.asarray(rows).reshape(-1)]
+        self._last_sample_ages = (float(ages.mean()), float(ages.max()))
+
+    def sample_age_metrics(self) -> Dict[str, float]:
+        """``Health/replay_age_*`` gauges of the most recent sample, in buffer-add
+        steps (see ``obs/health.py``); empty until something was sampled."""
+        if self._last_sample_ages is None:
+            return {}
+        mean, mx = self._last_sample_ages
+        return {"Health/replay_age_mean": mean, "Health/replay_age_max": mx}
 
     # -- sampling -----------------------------------------------------------
     def sample(
@@ -190,6 +218,7 @@ class ReplayBuffer:
         self, idxes: np.ndarray, batch_size: int, n_samples: int, sample_next_obs: bool, clone: bool
     ) -> Dict[str, np.ndarray]:
         env_idxes = self._rng.integers(0, self._n_envs, size=idxes.shape[0])
+        self._note_sample_ages(idxes)
         rows64 = idxes.astype(np.int64)
         env64 = env_idxes.astype(np.int64)
         out: Dict[str, np.ndarray] = {}
@@ -222,6 +251,7 @@ class ReplayBuffer:
         upper = self._buffer_size if self._full else self._pos
         idxes = self._rng.integers(0, upper, size=batch_dim)
         env_idxes = self._rng.integers(0, self._n_envs, size=batch_dim)
+        self._note_sample_ages(idxes)
         return idxes.reshape(n_samples, batch_size), env_idxes.reshape(n_samples, batch_size)
 
     def sample_tensors(
@@ -316,6 +346,14 @@ class ReplayBuffer:
             self._buf[k][:] = _np(src)
         self._pos = state["pos"]
         self._full = state["full"]
+        # Rebuild approximate write stamps (checkpoints predate staleness tracking):
+        # rows are stamped by their ring order ending at the write cursor, so ages
+        # resume sensible instead of treating every restored row as brand new.
+        n = len(self)
+        self._stamps[:] = 0
+        if n:
+            self._stamps[(self._pos - 1 - np.arange(n)) % self._buffer_size] = n - 1 - np.arange(n)
+        self._rows_added = n
         return self
 
 
@@ -360,8 +398,11 @@ class SequentialReplayBuffer(ReplayBuffer):
             valid = np.concatenate(
                 [np.arange(0, max(first_range_end, 0)), np.arange(self._pos, second_range_end)]
             ).astype(np.intp)
-            return valid[self._rng.integers(0, len(valid), size=batch_dim)]
-        return self._rng.integers(0, self._pos - sequence_length + 1, size=batch_dim)
+            starts = valid[self._rng.integers(0, len(valid), size=batch_dim)]
+        else:
+            starts = self._rng.integers(0, self._pos - sequence_length + 1, size=batch_dim)
+        self._note_sample_ages(starts)
+        return starts
 
     def _gather_sequences(
         self,
@@ -566,6 +607,17 @@ class EnvIndependentReplayBuffer:
             sel = env_ids == i
             starts[sel] = self._buf[i].sample_start_idxes(int(sel.sum()), sequence_length)
         return env_ids, starts
+
+    def sample_age_metrics(self) -> Dict[str, float]:
+        """Aggregate staleness over the per-env sub-buffers (each counts age in its
+        own add-steps): mean of sub-buffer means, max of maxes."""
+        stats = [s for s in (b.sample_age_metrics() for b in self._buf) if s]
+        if not stats:
+            return {}
+        return {
+            "Health/replay_age_mean": float(np.mean([s["Health/replay_age_mean"] for s in stats])),
+            "Health/replay_age_max": float(max(s["Health/replay_age_max"] for s in stats)),
+        }
 
     def state_dict(self) -> Dict[str, Any]:
         return {"buffers": [b.state_dict() for b in self._buf]}

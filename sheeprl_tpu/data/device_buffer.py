@@ -537,12 +537,29 @@ def make_device_replay(
     import contextlib
 
     from sheeprl_tpu.data.prefetch import make_replay_prefetcher
+    from sheeprl_tpu.obs import flight_recorder
     from sheeprl_tpu.utils.blocks import BlockDispatcher, IndexedBlockDispatcher
 
     kwargs = dict(dispatcher_kwargs or {})
     kwargs.setdefault("base_key", ctx.rng())
     batch_size = cfg.algo.per_rank_batch_size
     seq_len = cfg.algo.per_rank_sequence_length
+
+    # Flight recorder (obs/flight_recorder.py): every dispatched gradient block
+    # stages its inputs (device-array references — no sync, no copy) so a crash
+    # dumps the offending block.  The algorithm's main() registers the replay
+    # target; the block cadence needed to re-execute it exactly is recorded here.
+    recorder = flight_recorder.get_active()
+    base_key = kwargs["base_key"]
+    if recorder is not None:
+        recorder.arm_replay(
+            None,
+            block_kwargs={
+                "target_update_freq": int(kwargs.get("target_update_freq", 1)),
+                "count_offset": int(kwargs.get("count_offset", 1)),
+                "max_chunk": int(kwargs.get("max_chunk", 8)),
+            },
+        )
 
     if device_replay_enabled(ctx, cfg, require_sequential=require_sequential):
         mirror = make_mirror_for(
@@ -565,6 +582,21 @@ def make_device_replay(
 
         def run_block(carry, n: int, start_count: int, stage_next: bool = True):
             envs_idx, starts_idx = sample_index_block(rb, batch_size, seq_len, n, dp=dp)
+            if recorder is not None:
+                # Mirror rings are donated per scatter, so row references cannot
+                # outlive the dispatch: stage the sampled indices (the dump then
+                # carries state + indices; the batch is reconstructible from the
+                # host buffer, which stays the source of truth).
+                recorder.stage_step(
+                    carry=carry,
+                    base_key=base_key,
+                    scalars={
+                        "start_count": int(start_count),
+                        "n_steps": int(n),
+                        "envs_idx": np.asarray(envs_idx).tolist(),
+                        "starts_idx": np.asarray(starts_idx).tolist(),
+                    },
+                )
             arrays = mirror.global_view() if multiprocess else mirror.arrays
             return dispatcher.dispatch(carry, arrays, envs_idx, starts_idx, start_count)
 
@@ -575,6 +607,13 @@ def make_device_replay(
 
         def run_block(carry, n: int, start_count: int, stage_next: bool = True):
             sample = prefetcher.get(n, stage_next=stage_next) if prefetcher is not None else sample_block(n)
+            if recorder is not None:  # device-array references only: no host sync
+                recorder.stage_step(
+                    batches=sample,
+                    carry=carry,
+                    base_key=base_key,
+                    scalars={"start_count": int(start_count), "n_steps": len(sample)},
+                )
             return dispatcher.dispatch(carry, sample, start_count)
 
     # rb_lock stays internal: rb_add (below) and the prefetcher's sampler are the

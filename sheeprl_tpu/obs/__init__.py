@@ -1,20 +1,34 @@
-"""Unified training observability: span tracer, XProf integration, device telemetry.
+"""Unified training observability: span tracer, XProf integration, device telemetry,
+in-jit health diagnostics, flight recorder.
 
 Layers (bottom-up):
 
-* ``tracer``    — hierarchical span tracer (context manager + decorator), Chrome-trace/
-                  Perfetto JSON export, per-span latency histograms;
-* ``telemetry`` — ``Memory/*`` gauges from ``Device.memory_stats()`` with a host-RSS
-                  fallback on CPU backends;
-* ``watchdog``  — ``Compile/*`` counters + loud warnings on post-warmup recompiles;
-* ``monitor``   — ``TrainingMonitor``, the per-algorithm facade tying it together and
-                  driving ``jax.profiler`` step annotations / capture windows.
+* ``tracer``          — hierarchical span tracer (context manager + decorator),
+                        Chrome-trace/Perfetto JSON export, per-span latency histograms;
+* ``telemetry``       — ``Memory/*`` gauges from ``Device.memory_stats()`` with a
+                        host-RSS fallback on CPU backends;
+* ``watchdog``        — ``Compile/*`` counters + loud warnings on post-warmup
+                        recompiles;
+* ``health``          — ``Health/*`` training-health diagnostics computed INSIDE the
+                        jitted updates (grad/param/update norms, finite fraction,
+                        entropy/critic stats, replay staleness);
+* ``flight_recorder`` — bounded ring of structured events + staged batch/train-state,
+                        dumped to ``<log_dir>/blackbox/`` on crash;
+* ``replay_blackbox`` — ``python -m sheeprl_tpu.obs.replay_blackbox``: re-execute a
+                        dumped update step on CPU for deterministic repro;
+* ``monitor``         — ``TrainingMonitor``, the per-algorithm facade tying it
+                        together and driving ``jax.profiler`` step annotations /
+                        capture windows.
 
 Import note: ``utils.timer`` imports ``obs.tracer`` at module load so every existing
 ``with timer(...)`` block doubles as a span — nothing in this package may import
-``utils.timer``, and JAX is only imported lazily inside methods.
+``utils.timer`` at module load, and JAX is only imported lazily inside methods
+(``flight_recorder`` is stdlib-only until a dump actually happens).
 """
 
+from sheeprl_tpu.obs import flight_recorder
+from sheeprl_tpu.obs.flight_recorder import FlightRecorder
+from sheeprl_tpu.obs.health import health_metrics, replay_age_metrics
 from sheeprl_tpu.obs.monitor import TrainingMonitor
 from sheeprl_tpu.obs.telemetry import DeviceTelemetry
 from sheeprl_tpu.obs.tracer import SpanTracer, get_active, set_active, span, trace_span
@@ -23,10 +37,14 @@ from sheeprl_tpu.obs.watchdog import RecompileWarning, RecompileWatchdog
 __all__ = [
     "TrainingMonitor",
     "DeviceTelemetry",
+    "FlightRecorder",
     "SpanTracer",
     "RecompileWarning",
     "RecompileWatchdog",
+    "flight_recorder",
     "get_active",
+    "health_metrics",
+    "replay_age_metrics",
     "set_active",
     "span",
     "trace_span",

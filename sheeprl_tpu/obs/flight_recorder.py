@@ -1,0 +1,285 @@
+"""Flight recorder: a bounded in-memory ring of structured training events that dumps
+a post-mortem "black box" on crash.
+
+A long training run that dies after hours leaves (by default) nothing but a
+traceback.  The recorder keeps the last few thousand structured events — span
+closures, metric flushes, health snapshots, rollout worker restarts/timeouts,
+recompile events, strict-mode trips — in a lock-protected ring (O(1) append, fixed
+memory), and every update the training loop *stages* references to the current
+batch + train state (device arrays: staging is pointer bookkeeping, no host sync).
+When the run crashes (any exception escaping the algorithm entry point — see
+``cli.run_algorithm`` — including strict-mode ``NonFiniteError`` /
+``SignatureDriftError`` / ``RecompileError``), :func:`dump_active` writes
+``<log_dir>/blackbox/``:
+
+* ``events.jsonl``       — the last-K events, one JSON object per line;
+* ``meta.json``          — exception, algo, git SHA, jax/jaxlib versions, config
+  fingerprint, replay target;
+* ``config.yaml``        — the run's composed config;
+* ``state/ckpt_0/``      — the staged batch + train state + replay statics, written
+  through ``checkpoint.manager.CheckpointManager`` (barriers disabled: a crash dump
+  must never wait on peer processes).
+
+``python -m sheeprl_tpu.obs.replay_blackbox <blackbox_dir>`` reloads the dump and
+re-executes the failing update step on CPU (see ``replay_blackbox.py``) — the
+record-then-inspect loop of Podracer (arXiv:2104.06272) applied to crash forensics.
+
+Import constraints: stdlib-only at module load (``utils.timer`` → ``obs.tracer`` →
+this module feeds spans; JAX and the checkpoint manager are imported lazily at dump
+time only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import traceback
+import warnings
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_ACTIVE: Optional["FlightRecorder"] = None
+
+
+def get_active() -> Optional["FlightRecorder"]:
+    return _ACTIVE
+
+
+def install(recorder: Optional["FlightRecorder"]) -> Optional["FlightRecorder"]:
+    """Install ``recorder`` as the process-global flight recorder; returns the
+    previous one.  ``install(None)`` clears it (``cli.run_algorithm`` does this
+    after every run so recorders never leak across runs in one process)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = recorder
+    return prev
+
+
+def record_event(kind: str, **payload: Any) -> None:
+    """Record on the active recorder; no-op (one global load) when none is armed."""
+    if _ACTIVE is not None:
+        _ACTIVE.record(kind, **payload)
+
+
+def record_span(name: str, dur_ms: float, depth: int) -> None:
+    """Span-closure hook for ``obs.tracer`` (kept separate from :func:`record_event`
+    so the tracer's hot path pays exactly one global load when no recorder is on)."""
+    if _ACTIVE is not None:
+        _ACTIVE.record("span", name=name, dur_ms=round(float(dur_ms), 3), depth=depth)
+
+
+def dump_active(reason: str, exc: Optional[BaseException] = None) -> Optional[str]:
+    """Dump the active recorder's black box; returns the dump dir or None."""
+    if _ACTIVE is None:
+        return None
+    return _ACTIVE.dump(reason, exc)
+
+
+def _jsonable(value: Any) -> Any:
+    if isinstance(value, (str, int, bool)) or value is None:
+        return value
+    if isinstance(value, float):
+        return value if value == value and value not in (float("inf"), float("-inf")) else repr(value)
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    item = getattr(value, "item", None)
+    if callable(item) and getattr(value, "size", None) == 1:
+        try:
+            return _jsonable(item())
+        except Exception:
+            pass
+    return repr(value)
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except Exception:
+        return None
+
+
+def _config_fingerprint(cfg: Any) -> Optional[str]:
+    import hashlib
+
+    try:
+        blob = json.dumps(_jsonable(dict(cfg)), sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()
+    except Exception:
+        return None
+
+
+class FlightRecorder:
+    """Bounded, thread-safe event ring + staged-step storage + blackbox dump.
+
+    ``capacity`` bounds ring memory; ``keep_events`` bounds the dump (the tail of
+    the ring).  Thread safety matters: spans and metric flushes arrive from player/
+    trainer threads in the decoupled loops, worker restarts from the EnvPool's
+    watchdog path.
+    """
+
+    def __init__(
+        self,
+        log_dir: str,
+        capacity: int = 4096,
+        keep_events: int = 512,
+        algo: Optional[str] = None,
+        cfg: Any = None,
+    ):
+        self.log_dir = str(log_dir)
+        self.capacity = max(int(capacity), 1)
+        self.keep_events = max(int(keep_events), 1)
+        self.algo = algo
+        self.cfg = cfg
+        self.total_recorded = 0
+        self._events: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._staged: Dict[str, Any] = {}
+        self._statics: Dict[str, Any] = {}
+        self._replay_target: Optional[str] = None
+        self._staged_updates = 0
+        self._dumped: Optional[str] = None
+
+    # ------------------------------------------------------------------ events
+    def record(self, kind: str, **payload: Any) -> None:
+        event = {"ts": round(time.time(), 6), "kind": str(kind)}
+        for k, v in payload.items():
+            event[k] = _jsonable(v)
+        with self._lock:
+            self._events.append(event)
+            self.total_recorded += 1
+
+    def events(self, last: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._events)
+        return out if last is None else out[-last:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    # ------------------------------------------------------------------ staging
+    def arm_replay(self, target: Optional[str], **statics: Any) -> None:
+        """Register the dump's replay entry point (``"module:function"``) plus the
+        picklable host objects it needs to rebuild the update (spaces, action dims,
+        block cadence).  Call once per run; later calls merge ``statics``."""
+        if target is not None:
+            self._replay_target = str(target)
+        self._statics.update(statics)
+
+    def stage_step(self, **entries: Any) -> None:
+        """Stage the current update's inputs (device-array references + host
+        scalars).  No host sync, no copy: the arrays are fetched only if the run
+        crashes.  Replaces the previous stage, so at most one extra reference to
+        the previous params/batch is ever kept alive."""
+        self._staged = dict(entries)
+        self._staged_updates += 1
+
+    @property
+    def staged_updates(self) -> int:
+        return self._staged_updates
+
+    # ------------------------------------------------------------------ dump
+    def dump(self, reason: str, exc: Optional[BaseException] = None) -> Optional[str]:
+        """Write the black box.  First dump wins (a crash can unwind through several
+        layers that each try); every failure inside the dump path degrades to a
+        warning — the dump must never mask the original exception."""
+        if self._dumped is not None:
+            return self._dumped
+        out_dir = os.path.join(self.log_dir, "blackbox")
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+        except OSError as e:
+            warnings.warn(f"flight recorder: cannot create {out_dir}: {e}")
+            return None
+        self._dumped = out_dir
+
+        rank = 0
+        try:
+            import jax
+
+            rank = jax.process_index()
+        except Exception:
+            pass
+
+        events_name = "events.jsonl" if rank == 0 else f"events_rank{rank}.jsonl"
+        try:
+            with open(os.path.join(out_dir, events_name), "w") as f:
+                for event in self.events(last=self.keep_events):
+                    f.write(json.dumps(event) + "\n")
+        except Exception as e:
+            warnings.warn(f"flight recorder: could not write events: {e}")
+
+        staged_written = False
+        if rank == 0 and (self._staged or self._statics):
+            try:
+                staged_written = self._dump_state(out_dir)
+            except Exception as e:
+                warnings.warn(f"flight recorder: could not dump staged step state: {e}")
+
+        if rank == 0:
+            try:
+                self._dump_meta(out_dir, reason, exc, staged_written)
+            except Exception as e:
+                warnings.warn(f"flight recorder: could not write meta.json: {e}")
+            try:
+                if self.cfg is not None:
+                    from sheeprl_tpu.config.core import save_config
+
+                    save_config(self.cfg, os.path.join(out_dir, "config.yaml"))
+            except Exception as e:
+                warnings.warn(f"flight recorder: could not save config: {e}")
+        return out_dir
+
+    def _dump_state(self, out_dir: str) -> bool:
+        from sheeprl_tpu.checkpoint.manager import CheckpointManager
+
+        state: Dict[str, Any] = dict(self._staged)
+        if self._statics:
+            state["statics"] = dict(self._statics)
+        manager = CheckpointManager(os.path.join(out_dir, "state"), keep_last=None)
+        manager.save(0, state, sync=False)
+        return True
+
+    def _dump_meta(self, out_dir: str, reason: str, exc: Optional[BaseException], staged: bool) -> None:
+        meta: Dict[str, Any] = {
+            "reason": reason,
+            "algo": self.algo,
+            "time": time.time(),
+            "git_sha": _git_sha(),
+            "replay_target": self._replay_target,
+            "staged_state": staged,
+            "staged_updates": self._staged_updates,
+            "events_recorded": self.total_recorded,
+            "events_dumped": min(self.total_recorded, self.keep_events, self.capacity),
+            "config_fingerprint": _config_fingerprint(self.cfg) if self.cfg is not None else None,
+        }
+        try:
+            import jax
+            import jaxlib
+
+            meta["jax_version"] = jax.__version__
+            meta["jaxlib_version"] = jaxlib.__version__
+        except Exception:
+            pass
+        if exc is not None:
+            meta["exception"] = {
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "traceback": "".join(
+                    traceback.format_exception(type(exc), exc, exc.__traceback__)
+                )[-8000:],
+            }
+        with open(os.path.join(out_dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)

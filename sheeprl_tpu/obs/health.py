@@ -1,0 +1,155 @@
+"""In-jit training-health diagnostics (``Health/*`` metrics).
+
+The failure modes that kill long runs — NaN blow-ups, silent divergence, a learning
+rate that quietly stopped biting — are visible in quantities the update step already
+has in registers: gradient/parameter/update norms, the update-to-parameter ratio,
+the fraction of finite gradient elements, policy entropy, critic value statistics.
+:func:`health_metrics` computes them *inside* the existing jitted update as one extra
+scalar pytree merged into the step's metrics, so they ride the deferred-metrics path
+every loop already has (``WindowedFutures``/``BlockDispatcher`` drains, or the one
+``device_get`` per update in the on-policy loops) — **zero additional host syncs per
+step** and a few extra reductions fused into the update program.
+
+Per-module grouping: the top level of the grads/params/updates trees (``world_model``
+/ ``actor`` / ``critic`` for the Dreamer family, ``actor`` / ``critic`` / ``alpha``
+for SAC, encoder/actor/critic flax modules for PPO) becomes the metric suffix, e.g.
+``Health/grad_norm/actor``.  Single-key wrappers (flax's ``{"params": ...}``) are
+unwrapped first.
+
+Gated by ``obs.health`` (default on) at **trace time**: with the flag off the jitted
+program is bit-identical to the pre-health one.
+
+Host-side replay staleness (:func:`replay_age_metrics`) reads the sample-age stats
+the buffers in ``data/buffers.py`` record at sampling time — how many buffer-add
+steps old the rows of the most recent batch were — surfacing stale-replay bugs
+(e.g. a stuck rollout worker feeding an ever-older ring).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+_EPS = 1e-12
+
+
+def health_enabled(cfg: Any) -> bool:
+    """True unless ``obs.health`` is explicitly disabled (tolerates dicts/None)."""
+    if cfg is None:
+        return False
+    try:
+        obs_cfg = cfg.get("obs") if hasattr(cfg, "get") else getattr(cfg, "obs", None)
+    except Exception:
+        return False
+    if not obs_cfg:
+        return False
+    try:
+        return bool(obs_cfg.get("health", True) if hasattr(obs_cfg, "get") else getattr(obs_cfg, "health", True))
+    except Exception:
+        return False
+
+
+def _top_modules(tree: Any) -> Dict[str, Any]:
+    """Split a pytree into named top-level module subtrees.
+
+    Unwraps single-key mappings (``{"params": {...}}``) so flax param dicts group by
+    their real module names; non-mapping trees land under ``"all"``.
+    """
+    while isinstance(tree, Mapping) and len(tree) == 1:
+        tree = next(iter(tree.values()))
+    if isinstance(tree, Mapping) and tree:
+        return {str(k): v for k, v in tree.items()}
+    return {"all": tree}
+
+
+def diagnostics(
+    grads: Any = None,
+    params: Any = None,
+    updates: Any = None,
+    aux: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Pure-JAX training-health scalars; call inside a jitted update.
+
+    * ``Health/grad_norm/<module>`` / ``Health/param_norm/<module>`` /
+      ``Health/update_norm/<module>`` — per-top-level-module global norms;
+    * ``Health/update_ratio/<module>`` — update norm over param norm (the "effective
+      step size"; collapsing toward 0 = training stalled, exploding = divergence),
+      for modules present in both trees;
+    * ``Health/grad_finite_frac`` — fraction of finite gradient elements (1.0 in a
+      healthy run; the first number to look at in a blackbox dump);
+    * ``Health/<name>`` — the mean of every entry of ``aux`` (algorithm-specific
+      extras: policy entropy, Q-value/critic statistics).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    out: Dict[str, Any] = {}
+    grad_mods = _top_modules(grads) if grads is not None else {}
+    param_mods = _top_modules(params) if params is not None else {}
+    update_mods = _top_modules(updates) if updates is not None else {}
+
+    for name, g in grad_mods.items():
+        out[f"Health/grad_norm/{name}"] = optax.global_norm(g)
+    for name, p in param_mods.items():
+        out[f"Health/param_norm/{name}"] = optax.global_norm(p)
+    for name, u in update_mods.items():
+        u_norm = optax.global_norm(u)
+        out[f"Health/update_norm/{name}"] = u_norm
+        if name in param_mods:
+            out[f"Health/update_ratio/{name}"] = u_norm / (
+                out.get(f"Health/param_norm/{name}", optax.global_norm(param_mods[name])) + _EPS
+            )
+
+    if grads is not None:
+        leaves = [x for x in jax.tree.leaves(grads) if hasattr(x, "dtype")]
+        float_leaves = [x for x in leaves if jnp.issubdtype(x.dtype, jnp.floating)]
+        if float_leaves:
+            total = sum(x.size for x in float_leaves)  # static
+            finite = sum(jnp.isfinite(x).sum() for x in float_leaves)
+            out["Health/grad_finite_frac"] = finite.astype(jnp.float32) / float(total)
+
+    for name, value in (aux or {}).items():
+        if value is None:
+            continue
+        v = jnp.asarray(value)
+        out[f"Health/{name}"] = v if v.ndim == 0 else v.mean()
+    return out
+
+
+def health_metrics(
+    cfg: Any,
+    metrics: Dict[str, Any],
+    *,
+    grads: Any = None,
+    params: Any = None,
+    updates: Any = None,
+    aux: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Merge :func:`diagnostics` into a jitted update's metrics dict.
+
+    The ``obs.health`` gate is read at trace time, so a disabled run compiles the
+    exact pre-health program.  Also applies the ``analysis.inject_nan`` fault
+    injection (the flight-recorder e2e path) so a single call site per algorithm
+    covers both.
+    """
+    from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite
+
+    if health_enabled(cfg):
+        metrics = {**metrics, **diagnostics(grads=grads, params=params, updates=updates, aux=aux)}
+    return maybe_inject_nonfinite(cfg, metrics)
+
+
+def replay_age_metrics(rb: Any) -> Dict[str, float]:
+    """``Health/replay_age_*`` staleness gauges of ``rb``'s most recent sample.
+
+    Duck-typed: any buffer exposing ``sample_age_metrics()`` (see
+    ``data/buffers.py``) contributes; everything else returns ``{}`` so on-policy
+    loops and exotic buffers need no special casing.
+    """
+    fn = getattr(rb, "sample_age_metrics", None)
+    if fn is None:
+        return {}
+    try:
+        return dict(fn())
+    except Exception:
+        return {}

@@ -28,6 +28,7 @@ import os
 import warnings
 from typing import Any, Dict, Optional
 
+from sheeprl_tpu.obs import flight_recorder as _flight_recorder
 from sheeprl_tpu.obs import tracer as _tracer
 from sheeprl_tpu.obs.telemetry import DeviceTelemetry
 from sheeprl_tpu.obs.tracer import SpanTracer
@@ -52,6 +53,19 @@ class TrainingMonitor:
         self.tracer: Optional[SpanTracer] = None
         self._telemetry: Optional[DeviceTelemetry] = None
         self._watchdog: Optional[RecompileWatchdog] = None
+        # The flight recorder is INDEPENDENT of obs.enabled: crash forensics must
+        # work on runs that never turned the tracer on.  It stays installed after
+        # close() — cli.run_algorithm dumps it on crash and clears it afterwards.
+        self.recorder = None
+        if bool(obs_cfg.get("flight_recorder", True)):
+            self.recorder = _flight_recorder.FlightRecorder(
+                log_dir=log_dir,
+                capacity=int(obs_cfg.get("flight_recorder_capacity", 4096)),
+                keep_events=int(obs_cfg.get("flight_recorder_keep_events", 512)),
+                algo=(cfg.get("algo", {}) or {}).get("name"),
+                cfg=cfg,
+            )
+            _flight_recorder.install(self.recorder)
         if not self.enabled:
             return
 
@@ -139,6 +153,12 @@ class TrainingMonitor:
             elif update > self._warmup_updates + 1:
                 n = self._watchdog.poll_new()
                 if n:
+                    _flight_recorder.record_event(
+                        "recompile",
+                        update=update - 1,
+                        count=n,
+                        total=self._watchdog.total_compiles,
+                    )
                     msg = (
                         f"{n} post-warmup XLA recompilation(s) detected at update {update - 1} "
                         f"(total={self._watchdog.total_compiles}): a jitted function's input "
@@ -160,6 +180,18 @@ class TrainingMonitor:
         """Extra phase span, e.g. ``with monitor.span("Time/replay_ratio_wait"):``."""
         return _tracer._SpanContext(name, self.tracer)
 
+    @staticmethod
+    def phase(name: str):
+        """Named wall-clock phase: ``with monitor.phase("env_step"):`` accumulates
+        ``Time/phase_env_step`` seconds in the timer registry (and a span when the
+        tracer is on).  :meth:`log_metrics` folds the registry into every flush, so
+        any loop instrumented with phases gets the per-phase wall-clock breakdown
+        the DreamerV3 loop pioneered — independent of ``obs.enabled``, at the cost
+        of one ``perf_counter`` pair per block."""
+        from sheeprl_tpu.utils.timer import timer
+
+        return timer(f"Time/phase_{name}")
+
     def metrics(self) -> Dict[str, float]:
         """Span percentiles + memory/compile gauges, flattened for the logger."""
         if not self.enabled:
@@ -175,7 +207,27 @@ class TrainingMonitor:
         return out
 
     def log_metrics(self, logger, metrics: Dict[str, float], step: int) -> None:
-        """Merge the monitor's metrics and forward to the logger inside a log span."""
+        """Merge the monitor's metrics and forward to the logger inside a log span.
+
+        Runs two things regardless of ``obs.enabled``: (a) folds the named-timer
+        registry into the flush, so every loop instrumented with ``monitor.phase``
+        / ``with timer(...)`` reports the ``Time/phase_*`` wall-clock breakdown for
+        free, and (b) records a ``metric_flush`` event (with a Health/Loss
+        snapshot) on the flight recorder — the learning-dynamics trail a blackbox
+        dump is read by.
+        """
+        from sheeprl_tpu.utils.timer import timer as _timer
+
+        metrics.update(_timer.to_dict(reset=True))
+        if _flight_recorder.get_active() is not None:
+            snapshot = {
+                k: metrics[k]
+                for k in metrics
+                if k.startswith(("Health/", "Loss/", "Compile/", "Rollout/"))
+            }
+            _flight_recorder.record_event(
+                "metric_flush", step=step, n_metrics=len(metrics), values=snapshot
+            )
         if not self.enabled:
             if logger is not None:
                 logger.log_metrics(metrics, step)
@@ -232,20 +284,29 @@ class TrainingMonitor:
         return os.path.join(self.log_dir, name)
 
     def close(self) -> None:
-        if not self.enabled or self._closed:
+        if self._closed:
             return
         self._closed = True
-        if self._annotation is not None:
-            self._annotation.__exit__(None, None, None)
-            self._annotation = None
-        if self._capturing:
-            self._stop_capture()
-        if self._watchdog is not None:
-            self._watchdog.close()
-        if self.tracer is not None:
-            self.tracer.end(_UPDATE_SPAN)
-            try:
-                self.tracer.export_chrome_trace(self.trace_path())
-            except OSError as e:
-                warnings.warn(f"could not export Chrome trace: {e}")
-            _tracer.set_active(self._prev_tracer)
+        if self.enabled:
+            if self._annotation is not None:
+                self._annotation.__exit__(None, None, None)
+                self._annotation = None
+            if self._capturing:
+                self._stop_capture()
+            if self._watchdog is not None:
+                self._watchdog.close()
+            if self.tracer is not None:
+                self.tracer.end(_UPDATE_SPAN)
+                try:
+                    self.tracer.export_chrome_trace(self.trace_path())
+                except OSError as e:
+                    warnings.warn(f"could not export Chrome trace: {e}")
+                _tracer.set_active(self._prev_tracer)
+        # Strict runs drain outstanding in-jit nan_scan callbacks one last time
+        # AFTER teardown: a NaN in the final update (no later advance() to surface
+        # it) must still crash the run — and therefore trigger the blackbox dump —
+        # instead of exiting zero.
+        if self.strict:
+            from sheeprl_tpu.analysis.strict import raise_pending
+
+            raise_pending()

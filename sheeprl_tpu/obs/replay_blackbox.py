@@ -1,0 +1,155 @@
+"""Replay a flight-recorder black box: re-execute the failing update step on CPU.
+
+    python -m sheeprl_tpu.obs.replay_blackbox <log_dir>/blackbox [--platform cpu]
+
+The dump (see ``obs/flight_recorder.py``) carries the run's config, the staged batch
+and train state of the last dispatched update, and a *replay target* —
+``"module:function"`` registered by the algorithm via ``FlightRecorder.arm_replay``.
+The target function rebuilds the algorithm's jitted update from the config + dumped
+statics (spaces, action dims), restores the state through
+``CheckpointManager.load`` with freshly initialised templates, re-executes the
+single failing update, and returns its host-fetched outputs.  This module then
+scans every floating leaf for non-finite values and reports them — deterministic
+repro of a NaN blow-up without rerunning the multi-hour job.
+
+Platform selection happens BEFORE JAX initialises a backend (the whole point is
+replaying a TPU crash on a CPU dev box), so keep this module free of top-level jax
+imports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _force_platform(platform: str) -> None:
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
+
+def load_meta(blackbox_dir: os.PathLike) -> Dict[str, Any]:
+    with open(Path(blackbox_dir) / "meta.json") as f:
+        return json.load(f)
+
+
+def load_config(blackbox_dir: os.PathLike):
+    from sheeprl_tpu.config.core import DotDict, load_config as _load
+
+    return DotDict.wrap(_load(Path(blackbox_dir) / "config.yaml"))
+
+
+def state_dir(blackbox_dir: os.PathLike) -> Path:
+    return Path(blackbox_dir) / "state" / "ckpt_0"
+
+
+def load_state(blackbox_dir: os.PathLike, templates: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Load the dumped step state; ``templates`` restores typed pytrees (optimizer
+    NamedTuples) exactly — entries without a template come back as raw nested
+    dicts/arrays, which is what batches and flax param dicts need."""
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+
+    return CheckpointManager.load(state_dir(blackbox_dir), templates=templates)
+
+
+def as_step_list(raw: Any) -> List[Any]:
+    """msgpack round-trips python lists as ``{"0": ..., "1": ...}`` dicts; restore
+    the per-step batch list the block dispatcher was fed."""
+    if isinstance(raw, (list, tuple)):
+        return list(raw)
+    if isinstance(raw, dict) and raw and all(str(k).isdigit() for k in raw):
+        return [raw[k] for k in sorted(raw, key=int)]
+    return [raw]
+
+
+def scan_nonfinite(tree: Any, label: str = "") -> List[str]:
+    """Paths of every non-finite floating leaf in a host pytree."""
+    import jax
+    import numpy as np
+
+    bad: List[str] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad.append(f"{label}{jax.tree_util.keystr(path)}")
+    return bad
+
+
+def replay(blackbox_dir: os.PathLike, platform: str = "cpu") -> Tuple[Dict[str, Any], List[str]]:
+    """Re-execute the dumped update step; returns ``(outputs, nonfinite_paths)``.
+
+    ``outputs`` is whatever the replay target returns (host pytree — typically the
+    update's metrics plus summary norms of the new state).
+    """
+    _force_platform(platform)
+    meta = load_meta(blackbox_dir)
+    target = meta.get("replay_target")
+    if not target:
+        raise SystemExit(
+            f"blackbox at {blackbox_dir} has no replay target (algo={meta.get('algo')!r}): "
+            "the state was dumped for forensics but this algorithm did not register a "
+            "replay builder."
+        )
+    if not meta.get("staged_state"):
+        raise SystemExit(
+            f"blackbox at {blackbox_dir} has no staged step state — the crash happened "
+            "before the first update was dispatched."
+        )
+    cfg = load_config(blackbox_dir)
+    # The dump's mesh config may describe the crashed run's accelerator topology;
+    # replay runs on whatever this host has.
+    mesh = dict(cfg.get("mesh") or {})
+    mesh.update({"devices": None, "data": -1, "model": 1})
+    mesh.pop("distributed", None)
+    cfg["mesh"] = mesh
+
+    import importlib
+
+    mod_name, _, fn_name = target.rpartition(":")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    outputs = fn(cfg, Path(blackbox_dir))
+    return outputs, scan_nonfinite(outputs)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    parser.add_argument("blackbox_dir", help="<log_dir>/blackbox directory of a crashed run")
+    parser.add_argument("--platform", default="cpu", help="JAX platform to replay on (default: cpu)")
+    parser.add_argument("--json", action="store_true", help="emit a JSON report instead of text")
+    args = parser.parse_args(argv)
+
+    meta = load_meta(args.blackbox_dir)
+    outputs, nonfinite = replay(args.blackbox_dir, platform=args.platform)
+
+    if args.json:
+        import numpy as np
+
+        flat = {}
+        import jax
+
+        for path, leaf in jax.tree_util.tree_flatten_with_path(outputs)[0]:
+            arr = np.asarray(leaf)
+            flat[jax.tree_util.keystr(path)] = float(arr.reshape(-1)[0]) if arr.size == 1 else arr.shape
+        print(json.dumps({"algo": meta.get("algo"), "nonfinite": nonfinite, "outputs": {k: str(v) for k, v in flat.items()}}))
+    else:
+        print(f"replayed {meta.get('algo')!r} update from {args.blackbox_dir}")
+        exc = meta.get("exception") or {}
+        if exc:
+            print(f"original failure: {exc.get('type')}: {exc.get('message')}")
+        if nonfinite:
+            print(f"NON-FINITE REPRODUCED in {len(nonfinite)} output leaf/leaves:")
+            for path in nonfinite:
+                print(f"  {path}")
+        else:
+            print("update output is finite — the failure did not reproduce from the dumped state")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from sheeprl_tpu.obs import flight_recorder as _flight_recorder
 from sheeprl_tpu.utils.metric import HistogramMetric
 
 # (name, ts_us, dur_us, tid, depth) — kept as a flat tuple to stay allocation-light.
@@ -159,6 +160,9 @@ class SpanTracer:
                 self._events.append((name, ts_us, dur_us, tid, depth))
             else:
                 self.dropped_events += 1
+        # Span closures also feed the flight recorder's bounded event ring (one
+        # global load when no recorder is armed) — the dump's timeline context.
+        _flight_recorder.record_span(name, dur_us / 1e3, depth)
 
     # ------------------------------------------------------------------ export
     def percentiles(self, reset: bool = True) -> Dict[str, Dict[str, float]]:

@@ -42,6 +42,7 @@ import numpy as np
 from gymnasium.vector import AutoresetMode, VectorEnv
 from gymnasium.vector.utils import batch_space
 
+from sheeprl_tpu.obs import flight_recorder
 from sheeprl_tpu.obs.tracer import span
 from sheeprl_tpu.rollout.shared import RolloutSlabs
 from sheeprl_tpu.rollout.worker import worker_entry
@@ -251,8 +252,18 @@ class EnvPool(VectorEnv):
         with span("Rollout/restart"):
             while True:
                 self._total_restarts += 1
+                flight_recorder.record_event(
+                    "rollout_restart",
+                    worker=w.idx,
+                    reason=reason,
+                    restart=self._total_restarts,
+                    budget=self.max_restarts,
+                )
                 if self._total_restarts > self.max_restarts:
                     self.close(terminate=True)
+                    flight_recorder.record_event(
+                        "rollout_abort", worker=w.idx, reason=reason, restarts=self._total_restarts
+                    )
                     raise RolloutAbortError(
                         f"EnvPool exceeded max_restarts={self.max_restarts} "
                         f"(last failure: worker {w.idx}: {reason})"
@@ -349,9 +360,11 @@ class EnvPool(VectorEnv):
                 except _WorkerTimeout as e:
                     self._timeout_restarts += 1
                     failure = str(e)
+                    flight_recorder.record_event("rollout_timeout", worker=w.idx, error=failure)
                 except _WorkerCrashed as e:
                     self._crash_restarts += 1
                     failure = str(e)
+                    flight_recorder.record_event("rollout_crash", worker=w.idx, error=failure)
             self._restart(w, failure)
             # The replacement reset its envs and wrote fresh obs to the slab;
             # surface the break as a truncation (RestartOnException convention).

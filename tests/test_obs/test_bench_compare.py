@@ -1,0 +1,57 @@
+"""benchmarks/bench_compare.py: BENCH report diffing + regression flags."""
+
+import importlib.util
+import json
+import pathlib
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_compare", pathlib.Path(__file__).resolve().parents[2] / "benchmarks" / "bench_compare.py"
+)
+bench_compare = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_compare)
+
+
+def _report(tmp_path, name, metrics):
+    tail = "\n".join(json.dumps({"metric": m, "value": v, "unit": u}) for m, (v, u) in metrics.items())
+    path = tmp_path / name
+    path.write_text(json.dumps({"n": 1, "rc": 0, "tail": "noise line\n" + tail}))
+    return str(path)
+
+
+def test_extracts_metric_rows_from_tail(tmp_path):
+    path = _report(tmp_path, "BENCH_a.json", {"sps": (100.0, "grad_steps/s")})
+    assert bench_compare.extract_metrics(path) == {"sps": (100.0, "grad_steps/s")}
+
+
+def test_flags_throughput_drop_beyond_threshold(tmp_path):
+    base = _report(tmp_path, "BENCH_a.json", {"sps": (100.0, "grad_steps/s"), "lat": (10.0, "ms")})
+    new = _report(tmp_path, "BENCH_b.json", {"sps": (85.0, "grad_steps/s"), "lat": (10.5, "ms")})
+    report = bench_compare.compare(base, new, threshold=0.10)
+    assert report["regressions"] == ["sps"]  # -15% throughput; +5% latency is fine
+
+
+def test_latency_metrics_regress_upward(tmp_path):
+    base = _report(tmp_path, "BENCH_a.json", {"step_time_ms": (10.0, "ms")})
+    new = _report(tmp_path, "BENCH_b.json", {"step_time_ms": (12.0, "ms")})
+    report = bench_compare.compare(base, new, threshold=0.10)
+    assert report["regressions"] == ["step_time_ms"]
+
+
+def test_within_threshold_is_clean_and_cli_exit_codes(tmp_path, capsys):
+    base = _report(tmp_path, "BENCH_a.json", {"sps": (100.0, "grad_steps/s")})
+    new = _report(tmp_path, "BENCH_b.json", {"sps": (95.0, "grad_steps/s")})
+    assert bench_compare.main([base, new]) == 0
+    assert "no regressions" in capsys.readouterr().out
+
+    bad = _report(tmp_path, "BENCH_c.json", {"sps": (50.0, "grad_steps/s")})
+    assert bench_compare.main([base, bad]) == 0  # non-strict: warn only
+    assert bench_compare.main([base, bad, "--strict"]) == 1
+
+
+def test_disjoint_metric_sets_reported(tmp_path):
+    base = _report(tmp_path, "BENCH_a.json", {"old_metric": (1.0, "")})
+    new = _report(tmp_path, "BENCH_b.json", {"new_metric": (1.0, "")})
+    report = bench_compare.compare(base, new)
+    assert report["only_in_base"] == ["old_metric"]
+    assert report["only_in_new"] == ["new_metric"]
+    assert report["rows"] == [] and report["regressions"] == []

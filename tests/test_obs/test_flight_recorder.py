@@ -1,0 +1,133 @@
+"""Flight recorder units: ring bounds/rotation, thread safety, blackbox dumps."""
+
+import json
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.obs import flight_recorder as fr
+
+
+@pytest.fixture(autouse=True)
+def _no_active_recorder():
+    prev = fr.install(None)
+    yield
+    fr.install(prev)
+
+
+# ------------------------------------------------------------------ ring buffer
+def test_ring_is_bounded_and_keeps_the_tail():
+    r = fr.FlightRecorder("/tmp/unused", capacity=16)
+    for i in range(100):
+        r.record("tick", i=i)
+    assert len(r) == 16
+    assert r.total_recorded == 100
+    tail = r.events()
+    assert [e["i"] for e in tail] == list(range(84, 100))
+    assert [e["i"] for e in r.events(last=4)] == [96, 97, 98, 99]
+
+
+def test_ring_rotation_preserves_order_across_wraps():
+    r = fr.FlightRecorder("/tmp/unused", capacity=4)
+    for i in range(11):
+        r.record("e", i=i)
+    assert [e["i"] for e in r.events()] == [7, 8, 9, 10]
+
+
+def test_record_event_is_noop_without_active_recorder():
+    fr.record_event("orphan", x=1)  # must not raise
+    assert fr.get_active() is None
+    assert fr.dump_active("crash") is None
+
+
+def test_install_returns_previous():
+    a = fr.FlightRecorder("/tmp/a")
+    b = fr.FlightRecorder("/tmp/b")
+    assert fr.install(a) is None
+    assert fr.install(b) is a
+    fr.record_event("x")
+    assert len(b) == 1 and len(a) == 0
+
+
+def test_thread_safety_under_concurrent_records():
+    r = fr.FlightRecorder("/tmp/unused", capacity=256)
+    n_threads, per_thread = 8, 500
+
+    def worker(tid):
+        for i in range(per_thread):
+            r.record("t", tid=tid, i=i)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert r.total_recorded == n_threads * per_thread
+    assert len(r) == 256
+    for event in r.events():  # every entry intact, no torn writes
+        assert event["kind"] == "t" and 0 <= event["i"] < per_thread
+
+
+def test_jsonable_payloads():
+    r = fr.FlightRecorder("/tmp/unused")
+    r.record("x", f=float("nan"), arr=np.float32(2.5), big=np.arange(3), s="ok", none=None)
+    e = r.events()[-1]
+    json.dumps(e)  # everything JSON-serializable
+    assert e["arr"] == 2.5 and e["s"] == "ok" and e["none"] is None
+
+
+# ------------------------------------------------------------------ dumps
+def test_dump_writes_events_meta_and_staged_state(tmp_path):
+    r = fr.FlightRecorder(str(tmp_path), capacity=64, keep_events=8, algo="unittest",
+                          cfg={"seed": 1, "algo": {"name": "unittest"}})
+    for i in range(30):
+        r.record("tick", i=i)
+    r.arm_replay("some.module:replay_fn", note="static")
+    r.stage_step(
+        batch={"obs": jnp.ones((4, 3))},
+        carry={"params": {"w": jnp.zeros((2, 2))}},
+        scalars={"update": 7},
+    )
+    try:
+        raise ValueError("boom")
+    except ValueError as exc:
+        out = r.dump("crash", exc)
+
+    assert out == str(tmp_path / "blackbox")
+    events = [json.loads(line) for line in open(os.path.join(out, "events.jsonl"))]
+    assert len(events) == 8 and events[-1]["i"] == 29
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    assert meta["algo"] == "unittest"
+    assert meta["replay_target"] == "some.module:replay_fn"
+    assert meta["staged_state"] is True
+    assert meta["exception"]["type"] == "ValueError" and "boom" in meta["exception"]["message"]
+    assert meta["config_fingerprint"]
+
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+
+    state = CheckpointManager.load(os.path.join(out, "state", "ckpt_0"))
+    assert state["scalars"]["update"] == 7
+    assert state["statics"]["note"] == "static"
+    np.testing.assert_array_equal(np.asarray(state["batch"]["obs"]), np.ones((4, 3)))
+
+
+def test_first_dump_wins(tmp_path):
+    r = fr.FlightRecorder(str(tmp_path), keep_events=4)
+    r.record("a")
+    first = r.dump("crash")
+    r.record("b")
+    second = r.dump("crash")
+    assert first == second
+    events = [json.loads(line) for line in open(os.path.join(first, "events.jsonl"))]
+    assert [e["kind"] for e in events] == ["a"]
+
+
+def test_stage_step_replaces_previous():
+    r = fr.FlightRecorder("/tmp/unused")
+    r.stage_step(batch=1)
+    r.stage_step(batch=2)
+    assert r.staged_updates == 2
+    assert r._staged == {"batch": 2}

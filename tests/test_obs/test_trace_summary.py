@@ -53,3 +53,45 @@ def test_format_table_and_cli(trace_file, capsys):
     assert "Time/train_time" in out and "share" in out and "top-level wall clock" in out
     assert trace_summary.main([str(trace_file), "--json"]) == 0
     assert '"phases"' in capsys.readouterr().out
+
+
+# ------------------------------------------------------- blackbox event folding
+@pytest.fixture()
+def blackbox_log(tmp_path):
+    import json
+
+    from sheeprl_tpu.obs.flight_recorder import FlightRecorder
+
+    r = FlightRecorder(str(tmp_path), keep_events=64)
+    for i in range(3):
+        r.record("span", name="Time/update", dur_ms=10.0 + i, depth=0)
+        r.record("span", name="Time/phase_dispatch", dur_ms=4.0, depth=1)
+        r.record("metric_flush", step=i, n_metrics=5)
+    r.record("rollout_restart", worker=0, reason="timeout")
+    r.record("nonfinite", labels=["x"])
+    path = tmp_path / "events.jsonl"
+    with open(path, "w") as f:
+        for event in r.events():
+            f.write(json.dumps(event) + "\n")
+    return path
+
+
+def test_summarize_blackbox_events(blackbox_log):
+    summary = trace_summary.summarize(str(blackbox_log))
+    assert set(summary["phases"]) == {"Time/update", "Time/phase_dispatch"}
+    assert summary["phases"]["Time/update"]["count"] == 3
+    assert summary["top_level_total_ms"] == pytest.approx(33.0)
+    assert summary["events"] == {"metric_flush": 3, "rollout_restart": 1, "nonfinite": 1}
+
+
+def test_blackbox_table_includes_event_section(blackbox_log):
+    summary = trace_summary.summarize(str(blackbox_log))
+    table = trace_summary.format_table(summary)
+    assert "flight-recorder events:" in table
+    assert "rollout_restart: 1" in table
+
+
+def test_chrome_trace_path_still_detected(trace_file):
+    # The sniffing must not misroute ordinary Chrome traces.
+    summary = trace_summary.summarize(str(trace_file))
+    assert "events" not in summary
