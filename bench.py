@@ -213,7 +213,45 @@ def bench_e2e(replay_ratio: int = 1, total_steps: int | None = None, prefix: str
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_droq_utd20() -> dict:
+    """DroQ UTD-20 grad-steps/s over the device-ring fused-block path
+    (``buffer.device=True`` semantics: HBM transition ring + ONE donated dispatch
+    for the 20 critic updates + actor update, in-jit index sampling).  Rides
+    ``benchmarks/replay_bench.py`` at DroQ walker-ish shapes so future
+    BENCH_*.json track the ISSUE-5 dispatch-fusion win.  Set ``BENCH_DROQ=0`` to
+    skip."""
+    import argparse
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    try:
+        import replay_bench
+    finally:
+        sys.path.pop(0)
+    args = argparse.Namespace(
+        batch=128, hidden=256, obs_dim=17, act_dim=6, utd=20,
+        blocks=int(os.environ.get("BENCH_DROQ_BLOCKS", "8")),
+    )
+    rates = replay_bench.bench_sac_family("droq", args)
+    return {
+        "metric": "droq_utd20_grad_steps_per_sec",
+        "value": round(rates["device_ring"], 3),
+        "unit": f"grad_steps/s (device ring + fused block, batch {args.batch} x obs "
+        f"{args.obs_dim} x hidden {args.hidden}, UTD {args.utd}, 1 chip)",
+        "host_block_grad_steps_per_sec": round(rates["host_block"], 3),
+        "host_per_step_grad_steps_per_sec": round(rates["host_per_step"], 3),
+        "speedup_vs_host_per_step": round(rates["device_ring"] / rates["host_per_step"], 3),
+    }
+
+
 def main() -> None:
+    # DroQ UTD-20 fused-block row first: the collector parses the LAST JSON line
+    # as the headline metric, and bench_compare.py picks up every row in the tail.
+    if os.environ.get("BENCH_DROQ", "1") != "0":
+        try:
+            print(json.dumps(bench_droq_utd20()))
+        except Exception as exc:
+            print(json.dumps({"metric": "droq_utd20_grad_steps_per_sec", "error": str(exc)[:200]}))
     gsps, mfu = bench_train_only()
     extras = {}
     if os.environ.get("BENCH_E2E", "1") != "0":

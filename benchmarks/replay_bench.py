@@ -1,0 +1,255 @@
+"""Replay data-path benchmark for the SAC family: grad-steps/s of the three
+replay feeds at SAC and DroQ (UTD-20) shapes.
+
+* ``host_per_step``  — the naive off-policy loop: every gradient step pays a host
+  replay sample, its own host→device transfer, and its own jit dispatch (the
+  per-step overhead the ISSUE-5 fused blocks exist to remove);
+* ``host_block``     — the repo's pre-ring default: one ``[G, B]`` block sampled
+  and shipped per iteration, consumed by a scanned jit (1 host sample + 1
+  transfer + 1 dispatch per block; DroQ adds the separate actor dispatch);
+* ``device_ring``    — ``buffer.device=True``: HBM transition ring + fused
+  scanned block (``data/device_buffer.py`` + ``FusedRingDispatcher``) — in-jit
+  uniform index sampling from the carried key, zero per-step host work, ONE
+  donated dispatch per block (DroQ's critic scan + actor tail included).
+
+Emits one BENCH-style JSON row per (algo, path) on stdout plus speedup rows
+(feeds ``benchmarks/bench_compare.py``):
+
+    python benchmarks/replay_bench.py
+    python benchmarks/replay_bench.py --batch 256 --hidden 256 --blocks 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("SHEEPRL_TPU_QUIET", "1")
+
+import gymnasium as gym  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from sheeprl_tpu.config.core import compose  # noqa: E402
+from sheeprl_tpu.data.buffers import ReplayBuffer  # noqa: E402
+from sheeprl_tpu.data.device_buffer import DeviceTransitionRing  # noqa: E402
+from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh  # noqa: E402
+from sheeprl_tpu.utils.blocks import FusedRingDispatcher  # noqa: E402
+
+
+def _copy(tree):
+    return jax.tree.map(jnp.copy, tree)
+
+
+def _fill_buffer(args, n_envs=4, rows=512, seed=0):
+    rng = np.random.default_rng(seed)
+    rb = ReplayBuffer(rows, n_envs, obs_keys=("obs",))
+    rb.seed(seed)
+    ring = DeviceTransitionRing(
+        rows,
+        n_envs,
+        {
+            "obs": ((args.obs_dim,), jnp.float32),
+            "next_obs": ((args.obs_dim,), jnp.float32),
+            "actions": ((args.act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+    for t in range(rows):
+        row = {
+            "obs": rng.random((1, n_envs, args.obs_dim)).astype(np.float32),
+            "next_obs": rng.random((1, n_envs, args.obs_dim)).astype(np.float32),
+            "actions": rng.random((1, n_envs, args.act_dim)).astype(np.float32),
+            "rewards": rng.random((1, n_envs, 1)).astype(np.float32),
+            "dones": np.zeros((1, n_envs, 1), np.float32),
+        }
+        ring.add_step(row, rb._pos, rb.rows_added)
+        rb.add(row)
+    return rb, ring
+
+
+def _host_batch(rb, batch: int, n: int) -> Dict[str, jax.Array]:
+    sample = rb.sample(batch * n)
+    return {
+        "obs": jnp.asarray(sample["obs"].reshape(n, batch, -1)),
+        "next_obs": jnp.asarray(sample["next_obs"].reshape(n, batch, -1)),
+        "actions": jnp.asarray(sample["actions"].reshape(n, batch, -1)),
+        "rewards": jnp.asarray(sample["rewards"].reshape(n, batch, 1)),
+        "dones": jnp.asarray(sample["dones"].reshape(n, batch, 1)),
+    }
+
+
+def _time_blocks(run_block, carry, blocks: int, warmup: int = 2):
+    for i in range(warmup):
+        carry = run_block(carry, i)
+    jax.block_until_ready(carry)
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + blocks):
+        carry = run_block(carry, i)
+    jax.block_until_ready(carry)
+    return time.perf_counter() - t0
+
+
+def bench_sac_family(algo: str, args) -> Dict[str, float]:
+    """grad-steps/s for the three data paths; ``algo`` is "sac" (G=1 per block)
+    or "droq" (G=utd critic steps + the actor update per block)."""
+    from sheeprl_tpu.algos.sac.agent import SACActor, build_agent
+
+    utd = args.utd if algo == "droq" else 1
+    cfg = compose(
+        overrides=[
+            f"exp={algo}",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            f"algo.hidden_size={args.hidden}",
+            f"algo.per_rank_batch_size={args.batch}",
+        ]
+    )
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-1.0, 1.0, (args.obs_dim,), np.float32)})
+    act_space = gym.spaces.Box(-1.0, 1.0, (args.act_dim,), np.float32)
+    rb, ring = _fill_buffer(args)
+
+    if algo == "sac":
+        from sheeprl_tpu.algos.sac.sac import make_sac_fused_builder, make_sac_train_fn
+
+        actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+        actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
+        _, _, _, builder = make_sac_fused_builder(actor, critic, cfg, act_space, ring, args.batch)
+        opt_state = {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        }
+
+        def host_block(carry, i, n):
+            batches = _host_batch(rb, args.batch, n)
+            p, o, _ = train_fn(carry[0], carry[1], batches, jax.random.PRNGKey(i), jnp.asarray(i * n))
+            return (p, o)
+
+    else:
+        from sheeprl_tpu.algos.droq.droq import (
+            DroQCriticEnsemble,
+            make_droq_fused_builder,
+            make_droq_train_fns,
+        )
+
+        actor = SACActor(act_dim=args.act_dim, hidden_size=args.hidden, dtype=ctx.compute_dtype)
+        critic = DroQCriticEnsemble(
+            n_critics=cfg.algo.critic.n, hidden_size=args.hidden, dropout=cfg.algo.critic.dropout,
+            dtype=ctx.compute_dtype,
+        )
+        d_o, d_a = jnp.zeros((1, args.obs_dim)), jnp.zeros((1, args.act_dim))
+        params = {
+            "actor": actor.init(ctx.rng(), d_o),
+            "critic": critic.init({"params": ctx.rng(), "dropout": ctx.rng()}, d_o, d_a),
+            "log_alpha": jnp.asarray(0.0, jnp.float32),
+        }
+        params["critic_target"] = jax.tree.map(jnp.copy, params["critic"])
+        actor_opt, critic_opt, alpha_opt, train_critics_fn, train_actor_fn = make_droq_train_fns(
+            actor, critic, cfg, act_space
+        )
+        _, _, _, builder = make_droq_fused_builder(actor, critic, cfg, act_space, ring, args.batch)
+        opt_state = {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        }
+
+        def host_block(carry, i, n):
+            batches = _host_batch(rb, args.batch, n)
+            actor_batch = {"obs": jnp.asarray(rb.sample(args.batch)["obs"].reshape(args.batch, -1))}
+            p, o, _ = train_critics_fn(
+                carry[0], carry[1], batches, jax.random.PRNGKey(i), jnp.asarray(i * n)
+            )
+            p, o, _ = train_actor_fn(p, o, actor_batch, jax.random.PRNGKey(10_000 + i))
+            return (p, o)
+
+    carry0 = (params, opt_state)
+    rates: Dict[str, float] = {}
+
+    # host sampling + transfer + dispatch PER GRADIENT STEP
+    def per_step(carry, i):
+        for g in range(utd):
+            carry = host_block(carry, i * utd + g, 1)
+        return carry
+
+    elapsed = _time_blocks(per_step, _copy(carry0), args.blocks)
+    rates["host_per_step"] = args.blocks * utd / elapsed
+
+    # one [G, B] host block per iteration (the pre-ring default)
+    elapsed = _time_blocks(lambda c, i: host_block(c, i, utd), _copy(carry0), args.blocks)
+    rates["host_block"] = args.blocks * utd / elapsed
+
+    # device ring + fused scanned block (ONE donated dispatch per iteration)
+    fused = FusedRingDispatcher(
+        builder, base_key=jax.random.PRNGKey(0), last_sensitive=algo == "droq"
+    )
+    filled, rows_added = len(rb), rb.rows_added
+
+    def ring_block(carry, i):
+        return fused.dispatch(carry, ring.arrays, filled, rows_added, utd, i * utd)
+
+    elapsed = _time_blocks(ring_block, {"params": _copy(params), "opt_state": _copy(opt_state)},
+                           args.blocks)
+    rates["device_ring"] = args.blocks * utd / elapsed
+    return rates
+
+
+def main(argv: Optional[List[str]] = None) -> Dict[str, Dict[str, float]]:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--batch", type=int, default=128)
+    parser.add_argument("--hidden", type=int, default=256)
+    parser.add_argument("--obs-dim", type=int, default=17)
+    parser.add_argument("--act-dim", type=int, default=6)
+    parser.add_argument("--utd", type=int, default=20, help="DroQ gradient steps per env step")
+    parser.add_argument("--blocks", type=int, default=10, help="measured iterations per path")
+    parser.add_argument("--algos", type=str, default="sac,droq")
+    parser.add_argument("--json-out", type=str, default=None)
+    args = parser.parse_args(argv)
+
+    all_rates: Dict[str, Dict[str, float]] = {}
+    rows = []
+    for algo in [a.strip() for a in args.algos.split(",") if a.strip()]:
+        shape = (
+            f"batch {args.batch} x obs {args.obs_dim} x hidden {args.hidden}"
+            + (f", UTD {args.utd}" if algo == "droq" else "")
+        )
+        rates = bench_sac_family(algo, args)
+        all_rates[algo] = rates
+        for path, rate in rates.items():
+            rows.append(
+                {
+                    "metric": f"{algo}_replay_{path}_grad_steps_per_sec",
+                    "value": round(rate, 2),
+                    "unit": f"grad_steps/s ({shape})",
+                }
+            )
+        if rates.get("host_per_step", 0) > 0:
+            rows.append(
+                {
+                    "metric": f"{algo}_replay_device_ring_speedup_vs_per_step",
+                    "value": round(rates["device_ring"] / rates["host_per_step"], 3),
+                    "unit": f"x ({shape})",
+                }
+            )
+    for row in rows:
+        print(json.dumps(row))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(rows, f, indent=1)
+    return all_rates
+
+
+if __name__ == "__main__":
+    main()

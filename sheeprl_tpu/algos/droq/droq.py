@@ -11,7 +11,6 @@ steps of an iteration run in one ``lax.scan`` under jit."""
 
 from __future__ import annotations
 
-import contextlib
 import os
 import time
 from pathlib import Path
@@ -31,10 +30,11 @@ from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.data.device_buffer import make_transition_ring
+from sheeprl_tpu.data.prefetch import maybe_prefetcher
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
-from sheeprl_tpu.utils.blocks import WindowedFutures
+from sheeprl_tpu.utils.blocks import FusedRingDispatcher, WindowedFutures
 from sheeprl_tpu.models.blocks import MLP
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -83,6 +83,193 @@ class DroQCriticEnsemble(nn.Module):
         return ensemble(self.hidden_size, self.dropout, self.dtype)(x, deterministic).astype(jnp.float32)
 
 
+def make_droq_step_fns(actor, critic, cfg, act_space):
+    """Optimizers + the per-gradient-step DroQ updates as pure functions, shared by
+    the host-batch scans (:func:`make_droq_train_fns`) and the fused device-ring
+    block (:func:`make_droq_fused_builder`):
+
+    * ``critic_step(p, o_state, gstep, batch, key)`` — one shared-target ensemble
+      critic update followed by its EMA (``gstep`` is the cumulative count BEFORE
+      the step; the EMA cadence tests it post-increment);
+    * ``actor_step(p, o_state, obs, key)`` — the once-per-iteration actor + alpha
+      update on the mean of the Q-ensemble.
+    """
+    act_dim = int(np.prod(act_space.shape))
+    target_entropy = -act_dim
+    tau, gamma = cfg.algo.tau, cfg.algo.gamma
+    health = health_enabled(cfg)  # trace-time constant (obs/health.py)
+    target_update_freq = max(int(cfg.algo.critic.get("target_network_frequency", 1)), 1)
+    actor_opt = make_optimizer(cfg.algo.actor.optimizer, 0.0)
+    critic_opt = make_optimizer(cfg.algo.critic.optimizer, 0.0)
+    alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
+
+    def critic_step(p, o_state, gstep, batch, key):
+        k_next, k_drop = jax.random.split(key)
+        alpha = jnp.exp(p["log_alpha"])
+        next_mean, next_log_std = actor.apply(p["actor"], batch["next_obs"])
+        next_act, next_logp = actor.dist(next_mean, next_log_std).sample_and_log_prob(k_next)
+        next_logp = next_logp.sum(-1, keepdims=True)
+        q_next = critic.apply(p["critic_target"], batch["next_obs"], next_act, True).min(axis=0)
+        target = jax.lax.stop_gradient(
+            batch["rewards"] + (1 - batch["dones"]) * gamma * (q_next - alpha * next_logp)
+        )
+
+        def c_loss(cp):
+            qs = critic.apply(cp, batch["obs"], batch["actions"], False, rngs={"dropout": k_drop})
+            return ((qs - target[None]) ** 2).mean(axis=(1, 2)).sum()
+
+        cl, grads = jax.value_and_grad(c_loss)(p["critic"])
+        updates, new_c_state = critic_opt.update(grads, o_state["critic"], p["critic"])
+        p = {**p, "critic": optax.apply_updates(p["critic"], updates)}
+        do_update = ((gstep + 1) % target_update_freq) == 0
+        p = {
+            **p,
+            "critic_target": jax.tree.map(
+                lambda tp, cp: jnp.where(do_update, (1 - tau) * tp + tau * cp, tp),
+                p["critic_target"],
+                p["critic"],
+            ),
+        }
+        metrics = {"Loss/value_loss": cl}
+        if health:
+            metrics.update(
+                diagnostics(
+                    grads={"critic": grads},
+                    params=p,
+                    updates={"critic": updates},
+                    aux={"target_q_mean": target.mean()},
+                )
+            )
+        return p, {**o_state, "critic": new_c_state}, metrics
+
+    def actor_step(p, o_state, obs, key):
+        k_act, k_drop = jax.random.split(key)
+        alpha = jnp.exp(p["log_alpha"])
+
+        def a_loss(ap):
+            mean, log_std = actor.apply(ap, obs)
+            new_act, logp = actor.dist(mean, log_std).sample_and_log_prob(k_act)
+            logp = logp.sum(-1, keepdims=True)
+            # DroQ uses the ensemble MEAN, not the min (reference droq.py:126).
+            mean_q = critic.apply(p["critic"], obs, new_act, False, rngs={"dropout": k_drop}).mean(axis=0)
+            return actor_loss(alpha, logp, mean_q), logp
+
+        (al, logp), grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
+        updates, new_a_state = actor_opt.update(grads, o_state["actor"], p["actor"])
+        p = {**p, "actor": optax.apply_updates(p["actor"], updates)}
+
+        tl, t_grads = jax.value_and_grad(lambda la: alpha_loss(la, logp, target_entropy))(p["log_alpha"])
+        t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
+        p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
+        metrics = {"Loss/policy_loss": al, "Loss/alpha_loss": tl}
+        if health:
+            metrics.update(
+                diagnostics(
+                    grads={"actor": grads, "alpha": t_grads},
+                    params=p,
+                    updates={"actor": updates, "alpha": t_updates},
+                    aux={"policy_entropy": -logp.mean()},
+                )
+            )
+        return p, {**o_state, "actor": new_a_state, "alpha": new_t_state}, metrics
+
+    return actor_opt, critic_opt, alpha_opt, critic_step, actor_step
+
+
+def make_droq_train_fns(actor, critic, cfg, act_space):
+    """Host-replay-path jitted updates (the pre-ring dispatch shape): a scanned
+    ``[G, B]`` critic block plus a separate actor dispatch."""
+    strict = strict_enabled(cfg)
+    actor_opt, critic_opt, alpha_opt, critic_step, actor_step = make_droq_step_fns(actor, critic, cfg, act_space)
+
+    @jax.jit
+    def train_critics_fn(p, o_state, batches, key, grad_step0):
+        """G scanned critic updates with per-minibatch shared targets + EMA."""
+
+        def step(carry, batch):
+            p, o_state, gstep = carry
+            p, o_state, step_metrics = critic_step(p, o_state, gstep, batch, batch.pop("_key"))
+            return (p, o_state, gstep + 1), step_metrics
+
+        g = batches["obs"].shape[0]
+        batches["_key"] = jax.random.split(key, g)
+        (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, grad_step0), batches)
+        metrics = jax.tree.map(jnp.mean, metrics)
+        metrics = maybe_inject_nonfinite(cfg, metrics)
+        if strict:  # trace-time constant: the callback only exists in strict runs
+            nan_scan(metrics, "droq/train_critics_fn")
+        return p, o_state, metrics
+
+    @jax.jit
+    def train_actor_fn(p, o_state, batch, key):
+        return actor_step(p, o_state, batch["obs"], key)
+
+    return actor_opt, critic_opt, alpha_opt, train_critics_fn, train_actor_fn
+
+
+def make_droq_fused_builder(actor, critic, cfg, act_space, ring, batch_size: int):
+    """Block builder for :class:`~sheeprl_tpu.utils.blocks.FusedRingDispatcher`:
+    DroQ's whole UTD block — K scanned critic updates (each sampling its minibatch
+    in-jit from the carried key) AND the once-per-iteration actor+alpha update on
+    its own in-jit-sampled batch — as ONE donated jit dispatch.
+
+    ``last`` gates the actor tail so a chunk-decomposed block still runs the actor
+    exactly once per iteration (the dispatcher passes ``last=True`` only on the
+    closing chunk; build it with ``last_sensitive=True``).  Critic keys derive
+    from ``fold_in(critic_base, cumulative_step)`` and the actor key from the
+    separate ``actor_base`` stream, so chunked and fused dispatches are
+    bit-identical.
+    """
+    strict = strict_enabled(cfg)
+    health = health_enabled(cfg)
+    actor_opt, critic_opt, alpha_opt, critic_step, actor_step = make_droq_step_fns(actor, critic, cfg, act_space)
+    sample_gather = ring.make_sample_gather(batch_size)
+
+    def builder(k, last):
+        def block(carry, arrays, filled, rows_added, base_key, start_count):
+            c_base, a_base = jax.random.split(base_key)
+
+            def step(c, count):
+                p, o_state = c
+                k_sample, k_update = jax.random.split(jax.random.fold_in(c_base, count))
+                batch, age_metrics = sample_gather(arrays, filled, rows_added, k_sample)
+                p, o_state, metrics = critic_step(p, o_state, count, batch, k_update)
+                if health:  # replay staleness rides the same deferred-metrics tree
+                    metrics = {**metrics, **age_metrics}
+                return (p, o_state), metrics
+
+            p, o_state = carry["params"], carry["opt_state"]
+            metrics = {}
+            if k > 0:
+                counts = jnp.asarray(start_count, jnp.int32) + jnp.arange(k, dtype=jnp.int32)
+                (p, o_state), critic_metrics = jax.lax.scan(step, (p, o_state), counts)
+                metrics = jax.tree.map(jnp.mean, critic_metrics)
+            if last:
+                # The barrier stops XLA from fusing actor-tail ops into the critic
+                # scan (including re-deciding the ring buffers' loop handling
+                # because they are consumed again after it): without it the scan
+                # body compiles (one ulp) differently than the critic-only
+                # program, breaking the bit-identity contract between fused and
+                # chunk-decomposed dispatches.
+                p, o_state, tail_arrays = jax.lax.optimization_barrier((p, o_state, arrays))
+                # Iteration-unique actor key: start_count + k is the cumulative
+                # count closing this block, never reused by critic keys (own stream).
+                k_sample, k_update = jax.random.split(
+                    jax.random.fold_in(a_base, jnp.asarray(start_count, jnp.int32) + k)
+                )
+                abatch, _ = sample_gather(tail_arrays, filled, rows_added, k_sample)
+                p, o_state, actor_metrics = actor_step(p, o_state, abatch["obs"], k_update)
+                metrics = {**metrics, **actor_metrics}
+            metrics = maybe_inject_nonfinite(cfg, metrics)
+            if strict:  # trace-time constant: the callback only exists in strict runs
+                nan_scan(metrics, "droq/fused_block")
+            return {"params": p, "opt_state": o_state}, metrics
+
+        return block
+
+    return actor_opt, critic_opt, alpha_opt, builder
+
+
 @register_algorithm(name="droq")
 def main(ctx, cfg) -> None:
     rank = ctx.process_index
@@ -104,7 +291,6 @@ def main(ctx, cfg) -> None:
     rescale = np.isfinite(act_low).all() and np.isfinite(act_high).all()
     act_dim = int(np.prod(act_space.shape))
     obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
-    target_entropy = -act_dim
 
     actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size, dtype=ctx.compute_dtype)
     critic = DroQCriticEnsemble(
@@ -122,9 +308,12 @@ def main(ctx, cfg) -> None:
     params["critic_target"] = jax.tree.map(lambda x: x, params["critic"])
     params = ctx.replicate(params)
 
-    actor_opt = make_optimizer(cfg.algo.actor.optimizer, 0.0)
-    critic_opt = make_optimizer(cfg.algo.critic.optimizer, 0.0)
-    alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
+    actor_opt, critic_opt, alpha_opt, train_critics_fn, train_actor_fn = make_droq_train_fns(
+        actor, critic, cfg, act_space
+    )
+    # analysis.strict: signature guards on the jitted host-path updates
+    train_critics_fn = strict_guard(cfg, "droq/train_critics_fn", train_critics_fn)
+    train_actor_fn = strict_guard(cfg, "droq/train_actor_fn", train_actor_fn)
     opt_state = ctx.replicate(
         {
             "actor": actor_opt.init(params["actor"]),
@@ -148,107 +337,40 @@ def main(ctx, cfg) -> None:
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
 
-    tau, gamma, batch_size = cfg.algo.tau, cfg.algo.gamma, cfg.algo.per_rank_batch_size
-    health = health_enabled(cfg)  # trace-time constant (obs/health.py)
-    strict = strict_enabled(cfg)
+    batch_size = cfg.algo.per_rank_batch_size
+    futures = WindowedFutures()
 
     @jax.jit
     def act_fn(p, obs, key):
         mean, log_std = actor.apply(p, obs)
         return actor.dist(mean, log_std).sample(key)
 
-    target_update_freq = max(int(cfg.algo.critic.get("target_network_frequency", 1)), 1)
-
-    @jax.jit
-    def train_critics_fn(p, o_state, batches, key, grad_step0):
-        """G scanned critic updates with per-minibatch shared targets + EMA."""
-
-        def step(carry, batch):
-            p, o_state, gstep = carry
-            k_next, k_drop = jax.random.split(batch.pop("_key"))
-            alpha = jnp.exp(p["log_alpha"])
-            next_mean, next_log_std = actor.apply(p["actor"], batch["next_obs"])
-            next_act, next_logp = actor.dist(next_mean, next_log_std).sample_and_log_prob(k_next)
-            next_logp = next_logp.sum(-1, keepdims=True)
-            q_next = critic.apply(p["critic_target"], batch["next_obs"], next_act, True).min(axis=0)
-            target = jax.lax.stop_gradient(
-                batch["rewards"] + (1 - batch["dones"]) * gamma * (q_next - alpha * next_logp)
-            )
-
-            def c_loss(cp):
-                qs = critic.apply(cp, batch["obs"], batch["actions"], False, rngs={"dropout": k_drop})
-                return ((qs - target[None]) ** 2).mean(axis=(1, 2)).sum()
-
-            cl, grads = jax.value_and_grad(c_loss)(p["critic"])
-            updates, new_c_state = critic_opt.update(grads, o_state["critic"], p["critic"])
-            p = {**p, "critic": optax.apply_updates(p["critic"], updates)}
-            gstep = gstep + 1
-            do_update = (gstep % target_update_freq) == 0
-            p = {
-                **p,
-                "critic_target": jax.tree.map(
-                    lambda tp, cp: jnp.where(do_update, (1 - tau) * tp + tau * cp, tp),
-                    p["critic_target"],
-                    p["critic"],
-                ),
-            }
-            step_metrics = {"Loss/value_loss": cl}
-            if health:
-                step_metrics.update(
-                    diagnostics(
-                        grads={"critic": grads},
-                        params=p,
-                        updates={"critic": updates},
-                        aux={"target_q_mean": target.mean()},
-                    )
-                )
-            return (p, {**o_state, "critic": new_c_state}, gstep), step_metrics
-
-        g = batches["obs"].shape[0]
-        batches["_key"] = jax.random.split(key, g)
-        (p, o_state, _), metrics = jax.lax.scan(step, (p, o_state, grad_step0), batches)
-        metrics = jax.tree.map(jnp.mean, metrics)
-        metrics = maybe_inject_nonfinite(cfg, metrics)
-        if strict:  # trace-time constant: the callback only exists in strict runs
-            nan_scan(metrics, "droq/train_critics_fn")
-        return p, o_state, metrics
-
-    # analysis.strict: signature guard on the jitted critic update
-    train_critics_fn = strict_guard(cfg, "droq/train_critics_fn", train_critics_fn)
-
-    @jax.jit
-    def train_actor_fn(p, o_state, batch, key):
-        k_act, k_drop = jax.random.split(key)
-        alpha = jnp.exp(p["log_alpha"])
-
-        def a_loss(ap):
-            mean, log_std = actor.apply(ap, batch["obs"])
-            new_act, logp = actor.dist(mean, log_std).sample_and_log_prob(k_act)
-            logp = logp.sum(-1, keepdims=True)
-            # DroQ uses the ensemble MEAN, not the min (reference droq.py:126).
-            mean_q = critic.apply(p["critic"], batch["obs"], new_act, False, rngs={"dropout": k_drop}).mean(axis=0)
-            return actor_loss(alpha, logp, mean_q), logp
-
-        (al, logp), grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
-        updates, new_a_state = actor_opt.update(grads, o_state["actor"], p["actor"])
-        p = {**p, "actor": optax.apply_updates(p["actor"], updates)}
-
-        tl, t_grads = jax.value_and_grad(lambda la: alpha_loss(la, logp, target_entropy))(p["log_alpha"])
-        t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
-        p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
-        metrics = {"Loss/policy_loss": al, "Loss/alpha_loss": tl}
-        if health:
-            metrics.update(
-                diagnostics(
-                    grads={"actor": grads, "alpha": t_grads},
-                    params=p,
-                    updates={"actor": updates, "alpha": t_updates},
-                    aux={"policy_entropy": -logp.mean()},
-                )
-            )
-        return p, {**o_state, "actor": new_a_state, "alpha": new_t_state}, metrics
-
-    train_actor_fn = strict_guard(cfg, "droq/train_actor_fn", train_actor_fn)
+    # Device-resident replay (buffer.device=True, data/device_buffer.py): the
+    # transition ring lives in HBM and DroQ's whole UTD block — 20 critic updates
+    # plus the actor update at replay_ratio=20 — fuses into ONE donated jit
+    # dispatch with in-jit index sampling from the carried PRNG key.
+    ring = make_transition_ring(
+        ctx,
+        cfg,
+        rb,
+        {
+            "obs": ((obs_dim,), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+    fused = None
+    if ring is not None:
+        _, _, _, fused_builder = make_droq_fused_builder(actor, critic, cfg, act_space, ring, batch_size)
+        fused = FusedRingDispatcher(
+            fused_builder, base_key=ctx.rng(), futures=futures, last_sensitive=True
+        )
+        # Donation safety: critic_target aliases critic's buffers at init — a
+        # donated carry must not contain the same buffer twice.
+        params = jax.tree.map(jnp.copy, params)
+        opt_state = jax.tree.map(jnp.copy, opt_state)
 
     policy_steps_per_iter = num_envs * world
     total_steps = int(cfg.algo.total_steps)
@@ -273,6 +395,23 @@ def main(ctx, cfg) -> None:
         learning_starts += start_iter
         if cfg.buffer.checkpoint and "rb" in state:
             rb.load_state_dict(state["rb"])
+            if ring is not None and len(rb) > 0:
+                # The host buffer stays the source of truth: rebuild the HBM ring
+                # (and its staleness stamps) from the restored rows.
+                ring.load_from_transitions(
+                    {
+                        "obs": np.concatenate(
+                            [rb[k].reshape(rb.buffer_size, num_envs, -1) for k in mlp_keys], -1
+                        ),
+                        "next_obs": np.concatenate(
+                            [rb[f"next_{k}"].reshape(rb.buffer_size, num_envs, -1) for k in mlp_keys], -1
+                        ),
+                        "actions": rb["actions"],
+                        "rewards": rb["rewards"],
+                        "dones": rb["dones"],
+                    },
+                    stamps=rb.row_stamps,
+                )
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
     step_data: Dict[str, np.ndarray] = {}
@@ -297,22 +436,44 @@ def main(ctx, cfg) -> None:
         }
         return ctx.put_batch(batches, batch_axis=1), ctx.put_batch(actor_batch, batch_axis=0)
 
-    if cfg.algo.get("async_prefetch", True):
-        # Slice only the per-step critic block when reusing a staged bigger block;
-        # the actor batch has no step axis.
-        prefetcher = AsyncBatchPrefetcher(
-            _sample_block,
-            slice_fn=lambda block, n: (jax.tree.map(lambda x: x[:n], block[0]), block[1]),
-        )
-        rb_lock = prefetcher.lock
-    else:
-        prefetcher, rb_lock = None, contextlib.nullcontext()
-    futures = WindowedFutures()
+    # Slice only the per-step critic block when reusing a staged bigger block;
+    # the actor batch has no step axis.
+    prefetcher, rb_lock = maybe_prefetcher(
+        cfg,
+        _sample_block,
+        slice_fn=lambda block, n: (jax.tree.map(lambda x: x[:n], block[0]), block[1]),
+        enabled=ring is None,
+    )
 
     recorder = flight_recorder.get_active()
 
     def _dispatch_train(grad_steps: int, stage_next: bool) -> None:
         nonlocal params, opt_state, cumulative_grad_steps
+        if ring is not None:
+            # Fused device-ring block: the K critic updates AND the actor update
+            # land in one donated dispatch (the host path below pays two).
+            carry = fused.dispatch(
+                {"params": params, "opt_state": opt_state},
+                ring.arrays,
+                len(rb),
+                rb.rows_added,
+                grad_steps,
+                cumulative_grad_steps,
+            )
+            params, opt_state = carry["params"], carry["opt_state"]
+            cumulative_grad_steps += grad_steps
+            if recorder is not None:
+                # The pre-step state was DONATED into the block; re-stage
+                # post-dispatch with a device-side copy (async, no host sync).
+                recorder.stage_step(
+                    carry=jax.tree.map(jnp.copy, carry),
+                    scalars={
+                        "grad_step0": int(cumulative_grad_steps),
+                        "filled": len(rb),
+                        "rows_added": rb.rows_added,
+                    },
+                )
+            return
         batches, actor_batch = (
             prefetcher.get(grad_steps, stage_next=stage_next)
             if prefetcher is not None
@@ -366,11 +527,13 @@ def main(ctx, cfg) -> None:
                 if rb.empty:
                     deferred_dispatch = True
                 else:
-                    _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
+                    with monitor.phase("dispatch"):
+                        _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
 
         env_t0 = time.perf_counter()
         with timer("Time/env_interaction_time"):
-            next_obs, reward, terminated, truncated, info = envs.step(actions)
+            with monitor.phase("env_step"):
+                next_obs, reward, terminated, truncated, info = envs.step(actions)
             done = np.logical_or(terminated, truncated)
             real_next = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
             if done.any() and "final_obs" in info:
@@ -384,7 +547,23 @@ def main(ctx, cfg) -> None:
             step_data["actions"] = tanh_actions.astype(np.float32)[None]
             step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
             step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
-            with rb_lock:
+            with monitor.phase("buffer_add"), rb_lock:
+                if ring is not None:  # donated scatter at the host cursor, pre-add
+                    ring.add_step(
+                        {
+                            "obs": np.concatenate(
+                                [step_data[k].reshape(1, num_envs, -1) for k in mlp_keys], -1
+                            ),
+                            "next_obs": np.concatenate(
+                                [step_data[f"next_{k}"].reshape(1, num_envs, -1) for k in mlp_keys], -1
+                            ),
+                            "actions": step_data["actions"],
+                            "rewards": step_data["rewards"],
+                            "dones": step_data["dones"],
+                        },
+                        rb._pos,
+                        rb.rows_added,
+                    )
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
             obs = next_obs
             policy_step += policy_steps_per_iter
@@ -392,7 +571,8 @@ def main(ctx, cfg) -> None:
         env_time += time.perf_counter() - env_t0
 
         if deferred_dispatch:
-            _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
+            with monitor.phase("dispatch"):
+                _dispatch_train(grad_steps, stage_next=iter_num < num_iters)
 
         if logger is not None and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run

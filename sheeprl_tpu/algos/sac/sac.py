@@ -9,7 +9,6 @@ scan.  The replay-ratio ``Ratio`` governor decides G exactly as in the reference
 
 from __future__ import annotations
 
-import contextlib
 import os
 import time
 from pathlib import Path
@@ -28,11 +27,12 @@ from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.data.device_buffer import make_transition_ring
+from sheeprl_tpu.data.prefetch import maybe_prefetcher
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.rollout import PipelinedPlayer, rollout_metrics
-from sheeprl_tpu.utils.blocks import WindowedFutures
+from sheeprl_tpu.utils.blocks import FusedRingDispatcher, WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -41,15 +41,21 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio
 
 
-def make_sac_train_fn(actor, critic, cfg, act_space):
-    """Optimizers + the jitted scanned SAC update; shared by the coupled and
-    decoupled entry points."""
+def make_sac_step_fn(actor, critic, cfg, act_space):
+    """The per-gradient-step SAC update as a pure function, shared by the host-batch
+    scan (:func:`make_sac_train_fn`) and the fused device-ring block
+    (:func:`make_sac_fused_builder`):
+
+        step_update(p, o_state, gstep, batch, key) -> (p, o_state, metrics)
+
+    ``gstep`` is the cumulative gradient-step count BEFORE this step (the EMA
+    target cadence tests it post-increment, matching the eager reference).
+    Returns the optimizers too — the callers init/restore optimizer state."""
     act_dim = int(np.prod(act_space.shape))
     target_entropy = -act_dim
     tau = cfg.algo.tau
     gamma = cfg.algo.gamma
 
-    strict = strict_enabled(cfg)
     health = health_enabled(cfg)  # trace-time constant (obs/health.py)
     actor_opt = make_optimizer(cfg.algo.actor.optimizer, cfg.algo.get("max_grad_norm", 0.0))
     critic_opt = make_optimizer(cfg.algo.critic.optimizer, cfg.algo.get("max_grad_norm", 0.0))
@@ -95,49 +101,62 @@ def make_sac_train_fn(actor, critic, cfg, act_space):
 
     target_update_freq = max(int(cfg.algo.critic.get("target_network_frequency", 1)), 1)
 
+    def step_update(p, o_state, gstep, batch, key):
+        c_loss, a_loss, t_loss = _losses(p, batch, key)
+
+        (cl, q_aux), c_grads = jax.value_and_grad(c_loss, has_aux=True)(p["critic"])
+        c_updates, new_c_state = critic_opt.update(c_grads, o_state["critic"], p["critic"])
+        p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
+
+        # Actor minimises against the freshly-updated critic (reference sac.py:49-63).
+        (al, logp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"], p["critic"])
+        a_updates, new_a_state = actor_opt.update(a_grads, o_state["actor"], p["actor"])
+        p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
+
+        tl, t_grads = jax.value_and_grad(t_loss)(p["log_alpha"], logp)
+        t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
+        p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
+
+        # EMA target update, gated on critic.target_network_frequency (reference
+        # sac.py:349-355 gates on the update counter; freq=1 ⇒ every step).
+        do_update = ((gstep + 1) % target_update_freq) == 0
+        p = {
+            **p,
+            "critic_target": jax.tree.map(
+                lambda tp, cp: jnp.where(do_update, (1 - tau) * tp + tau * cp, tp),
+                p["critic_target"],
+                p["critic"],
+            ),
+        }
+        o_state = {"actor": new_a_state, "critic": new_c_state, "alpha": new_t_state}
+        metrics = {"Loss/value_loss": cl, "Loss/policy_loss": al, "Loss/alpha_loss": tl}
+        if health:  # per-module norms/ratios + entropy/Q stats, one scalar tree
+            metrics.update(
+                diagnostics(
+                    grads={"critic": c_grads, "actor": a_grads, "alpha": t_grads},
+                    params=p,
+                    updates={"critic": c_updates, "actor": a_updates, "alpha": t_updates},
+                    aux={"policy_entropy": -logp.mean(), **q_aux},
+                )
+            )
+        return p, o_state, metrics
+
+    return actor_opt, critic_opt, alpha_opt, step_update
+
+
+def make_sac_train_fn(actor, critic, cfg, act_space):
+    """Optimizers + the jitted scanned SAC update over host-shipped ``[G, B, ...]``
+    batch blocks; shared by the coupled and decoupled entry points (host replay
+    path) and the flight-recorder replay builder."""
+    strict = strict_enabled(cfg)
+    actor_opt, critic_opt, alpha_opt, step_update = make_sac_step_fn(actor, critic, cfg, act_space)
+
     @jax.jit
     def train_fn(p, o_state, batches, key, grad_step0):
         def step(carry, batch):
             p, o_state, gstep = carry
-            c_loss, a_loss, t_loss = _losses(p, batch, batch.pop("_key"))
-
-            (cl, q_aux), c_grads = jax.value_and_grad(c_loss, has_aux=True)(p["critic"])
-            c_updates, new_c_state = critic_opt.update(c_grads, o_state["critic"], p["critic"])
-            p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
-
-            # Actor minimises against the freshly-updated critic (reference sac.py:49-63).
-            (al, logp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"], p["critic"])
-            a_updates, new_a_state = actor_opt.update(a_grads, o_state["actor"], p["actor"])
-            p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
-
-            tl, t_grads = jax.value_and_grad(t_loss)(p["log_alpha"], logp)
-            t_updates, new_t_state = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
-            p = {**p, "log_alpha": optax.apply_updates(p["log_alpha"], t_updates)}
-
-            # EMA target update, gated on critic.target_network_frequency (reference
-            # sac.py:349-355 gates on the update counter; freq=1 ⇒ every step).
-            gstep = gstep + 1
-            do_update = (gstep % target_update_freq) == 0
-            p = {
-                **p,
-                "critic_target": jax.tree.map(
-                    lambda tp, cp: jnp.where(do_update, (1 - tau) * tp + tau * cp, tp),
-                    p["critic_target"],
-                    p["critic"],
-                ),
-            }
-            o_state = {"actor": new_a_state, "critic": new_c_state, "alpha": new_t_state}
-            metrics = {"Loss/value_loss": cl, "Loss/policy_loss": al, "Loss/alpha_loss": tl}
-            if health:  # per-module norms/ratios + entropy/Q stats, one scalar tree
-                metrics.update(
-                    diagnostics(
-                        grads={"critic": c_grads, "actor": a_grads, "alpha": t_grads},
-                        params=p,
-                        updates={"critic": c_updates, "actor": a_updates, "alpha": t_updates},
-                        aux={"policy_entropy": -logp.mean(), **q_aux},
-                    )
-                )
-            return (p, o_state, gstep), metrics
+            p, o_state, metrics = step_update(p, o_state, gstep, batch, batch.pop("_key"))
+            return (p, o_state, gstep + 1), metrics
 
         g = batches["obs"].shape[0]
         batches["_key"] = jax.random.split(key, g)
@@ -149,6 +168,48 @@ def make_sac_train_fn(actor, critic, cfg, act_space):
         return p, o_state, metrics
 
     return actor_opt, critic_opt, alpha_opt, train_fn
+
+
+def make_sac_fused_builder(actor, critic, cfg, act_space, ring, batch_size: int):
+    """Block builder for :class:`~sheeprl_tpu.utils.blocks.FusedRingDispatcher`:
+    the whole K-step UTD block — in-jit uniform index sampling from the carried
+    PRNG key, HBM batch gather, and K scanned :func:`make_sac_step_fn` updates —
+    compiles to ONE jit with the carry (params + opt state) donated.
+
+    Per-step keys derive as ``fold_in(base_key, cumulative_step)``, so any chunk
+    decomposition of a block is bit-identical to the fused whole (the parity
+    contract ``tests/test_algos/test_fused_blocks.py`` pins).
+
+    Returns ``(optimizers..., builder)`` where ``builder(k, last)`` is the
+    dispatcher's block factory (``last`` is ignored — SAC has no per-block tail).
+    """
+    strict = strict_enabled(cfg)
+    health = health_enabled(cfg)
+    actor_opt, critic_opt, alpha_opt, step_update = make_sac_step_fn(actor, critic, cfg, act_space)
+    sample_gather = ring.make_sample_gather(batch_size)
+
+    def builder(k, last):
+        def block(carry, arrays, filled, rows_added, base_key, start_count):
+            def step(c, count):
+                p, o_state = c
+                k_sample, k_update = jax.random.split(jax.random.fold_in(base_key, count))
+                batch, age_metrics = sample_gather(arrays, filled, rows_added, k_sample)
+                p, o_state, metrics = step_update(p, o_state, count, batch, k_update)
+                if health:  # replay staleness rides the same deferred-metrics tree
+                    metrics = {**metrics, **age_metrics}
+                return (p, o_state), metrics
+
+            counts = jnp.asarray(start_count, jnp.int32) + jnp.arange(k, dtype=jnp.int32)
+            (p, o_state), metrics = jax.lax.scan(step, (carry["params"], carry["opt_state"]), counts)
+            metrics = jax.tree.map(jnp.mean, metrics)
+            metrics = maybe_inject_nonfinite(cfg, metrics)
+            if strict:  # trace-time constant: the callback only exists in strict runs
+                nan_scan(metrics, "sac/fused_block")
+            return {"params": p, "opt_state": o_state}, metrics
+
+        return block
+
+    return actor_opt, critic_opt, alpha_opt, builder
 
 
 @register_algorithm(name="sac")
@@ -204,6 +265,46 @@ def main(ctx, cfg) -> None:
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
 
     batch_size = cfg.algo.per_rank_batch_size
+    futures = WindowedFutures()
+
+    # Device-resident replay (buffer.device=True, data/device_buffer.py): the
+    # transition ring lives in HBM, index sampling happens inside the fused
+    # scanned block from the carried PRNG key, and a whole Ratio-sized gradient
+    # block is ONE jit dispatch with the train state donated.
+    obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
+    act_dim = int(np.prod(act_space.shape))
+    ring = make_transition_ring(
+        ctx,
+        cfg,
+        rb,
+        {
+            "obs": ((obs_dim,), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+    fused = None
+    if ring is not None:
+        _, _, _, fused_builder = make_sac_fused_builder(actor, critic, cfg, act_space, ring, batch_size)
+        fused = FusedRingDispatcher(fused_builder, base_key=ctx.rng(), futures=futures)
+        # Donation safety: critic_target aliases critic's buffers at init (the
+        # identity tree.map in build_agent) — a donated carry must not contain the
+        # same buffer twice, so deep-copy the train state once up front.
+        params = jax.tree.map(jnp.copy, params)
+        opt_state = jax.tree.map(jnp.copy, opt_state)
+
+    def _ring_transitions():
+        return {
+            "obs": np.concatenate([step_data[k].reshape(1, num_envs, -1) for k in mlp_keys], -1),
+            "next_obs": np.concatenate(
+                [step_data[f"next_{k}"].reshape(1, num_envs, -1) for k in mlp_keys], -1
+            ),
+            "actions": step_data["actions"],
+            "rewards": step_data["rewards"],
+            "dones": step_data["dones"],
+        }
 
     @jax.jit
     def act_fn(p, obs, key):
@@ -239,6 +340,23 @@ def main(ctx, cfg) -> None:
         learning_starts += start_iter
         if cfg.buffer.checkpoint and "rb" in state:
             rb.load_state_dict(state["rb"])
+            if ring is not None and len(rb) > 0:
+                # The host buffer stays the source of truth: rebuild the HBM ring
+                # (and its staleness stamps) from the restored rows.
+                ring.load_from_transitions(
+                    {
+                        "obs": np.concatenate(
+                            [rb[k].reshape(rb.buffer_size, num_envs, -1) for k in mlp_keys], -1
+                        ),
+                        "next_obs": np.concatenate(
+                            [rb[f"next_{k}"].reshape(rb.buffer_size, num_envs, -1) for k in mlp_keys], -1
+                        ),
+                        "actions": rb["actions"],
+                        "rewards": rb["rewards"],
+                        "dones": rb["dones"],
+                    },
+                    stamps=rb.row_stamps,
+                )
 
     obs, _ = envs.reset(seed=cfg.seed + rank)
     step_data: Dict[str, np.ndarray] = {}
@@ -278,15 +396,37 @@ def main(ctx, cfg) -> None:
         }
         return ctx.put_batch(batches, batch_axis=1)
 
-    if cfg.algo.get("async_prefetch", True):
-        prefetcher = AsyncBatchPrefetcher(_sample_block)
-        rb_lock = prefetcher.lock
-    else:
-        prefetcher, rb_lock = None, contextlib.nullcontext()
-    futures = WindowedFutures()
+    prefetcher, rb_lock = maybe_prefetcher(cfg, _sample_block, enabled=ring is None)
 
     def _dispatch_train(grad_steps: int, stage_next: bool) -> None:
         nonlocal params, opt_state, cumulative_grad_steps
+        if ring is not None:
+            # Fused device-ring block: ONE donated dispatch for the whole K-step
+            # UTD block; even the index sampling runs in-jit off the carried key.
+            carry = fused.dispatch(
+                {"params": params, "opt_state": opt_state},
+                ring.arrays,
+                len(rb),
+                rb.rows_added,
+                grad_steps,
+                cumulative_grad_steps,
+            )
+            params, opt_state = carry["params"], carry["opt_state"]
+            cumulative_grad_steps += grad_steps
+            if recorder is not None:
+                # The pre-step state was DONATED into the block — its buffers no
+                # longer exist, so re-stage post-dispatch with a device-side copy
+                # (async, no host sync); the dump then carries the state entering
+                # the NEXT block plus the counters that derive its in-jit samples.
+                recorder.stage_step(
+                    carry=jax.tree.map(jnp.copy, carry),
+                    scalars={
+                        "grad_step0": int(cumulative_grad_steps),
+                        "filled": len(rb),
+                        "rows_added": rb.rows_added,
+                    },
+                )
+            return
         batches = (
             prefetcher.get(grad_steps, stage_next=stage_next)
             if prefetcher is not None
@@ -365,6 +505,8 @@ def main(ctx, cfg) -> None:
             # Truncated episodes still bootstrap (done=0 in the TD target).
             step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
             with monitor.phase("buffer_add"), rb_lock:
+                if ring is not None:  # donated scatter at the host cursor, pre-add
+                    ring.add_step(_ring_transitions(), rb._pos, rb.rows_added)
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
             obs = next_obs
             policy_step += policy_steps_per_iter
@@ -451,6 +593,19 @@ def replay_update(cfg, dump_dir):
     templates = {"carry": jax.device_get({"params": params0, "opt_state": opt0})}
     state = replay_blackbox.load_state(dump_dir, templates)
     carry = state["carry"]
+    if "batch" not in state:
+        # Device-ring dump (buffer.device=True): the donated fused block stages
+        # the post-block state + the counters that derive its in-jit samples, not
+        # a batch (see howto/device_replay.md).  Re-executing needs the run's
+        # checkpointed host buffer; report what IS replayable instead of KeyError.
+        raise RuntimeError(
+            "this blackbox dump comes from the device-ring fused path: it stages "
+            "the train state entering the failing block plus its sampling "
+            f"counters ({ {k: v for k, v in state.get('scalars', {}).items()} }), "
+            "but no batch. Rebuild the batch from the run's checkpointed replay "
+            "buffer (buffer.checkpoint=True) and the dumped counters, or rerun "
+            "with buffer.device=False to capture host-shipped batches."
+        )
     new_params, _, metrics = train_fn(
         ctx.replicate(carry["params"]),
         ctx.replicate(carry["opt_state"]),

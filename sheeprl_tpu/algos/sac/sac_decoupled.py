@@ -28,12 +28,14 @@ import numpy as np
 
 from sheeprl_tpu.analysis.strict import assert_finite, strict_guard
 from sheeprl_tpu.algos.sac.agent import build_agent
-from sheeprl_tpu.algos.sac.sac import make_sac_train_fn
+from sheeprl_tpu.algos.sac.sac import make_sac_fused_builder, make_sac_train_fn
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_buffer import make_transition_ring
 from sheeprl_tpu.obs import TrainingMonitor
+from sheeprl_tpu.utils.blocks import FusedRingDispatcher
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -99,6 +101,36 @@ def main(ctx, cfg) -> None:
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     batch_size = cfg.algo.per_rank_batch_size
 
+    # Device-resident replay (buffer.device=True, data/device_buffer.py): the
+    # player scatters rows into the HBM transition ring and ships only counters;
+    # the learner runs the whole gradient block as ONE donated fused dispatch
+    # with in-jit index sampling.  ``ring_lock`` serialises the player's donating
+    # scatter against the learner's dispatch — without it, the learner could
+    # dispatch with ring buffers the scatter just donated.
+    obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
+    act_dim = int(np.prod(act_space.shape))
+    ring = make_transition_ring(
+        ctx,
+        cfg,
+        rb,
+        {
+            "obs": ((obs_dim,), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+    ring_lock = threading.Lock()
+    fused = None
+    if ring is not None:
+        _, _, _, fused_builder = make_sac_fused_builder(actor, critic, cfg, act_space, ring, batch_size)
+        fused = FusedRingDispatcher(fused_builder, base_key=ctx.rng())
+        # Donation safety: critic_target aliases critic's buffers at init — a
+        # donated carry must not contain the same buffer twice.
+        params = jax.tree.map(jnp.copy, params)
+        opt_state = jax.tree.map(jnp.copy, opt_state)
+
     @jax.jit
     def act_fn(p, obs, key):
         mean, log_std = actor.apply(p, obs)
@@ -141,7 +173,10 @@ def main(ctx, cfg) -> None:
     def player() -> None:
         """Env + buffer role (reference ``player()``, ``sac_decoupled.py:33-…``)."""
         key = jax.random.PRNGKey(cfg.seed + 10_000 + rank)
-        local_params = params
+        # Ring path: the learner DONATES its params into every fused dispatch, so
+        # the player must act on an independent copy (only the actor is needed);
+        # published updates below are copies for the same reason.
+        local_params = params if ring is None else {"actor": jax.tree.map(jnp.copy, params["actor"])}
         policy_step = policy_step0
         last_ckpt = last_checkpoint
         try:
@@ -186,6 +221,26 @@ def main(ctx, cfg) -> None:
                     step_data["actions"] = tanh_actions.astype(np.float32)[None]
                     step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
                     step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
+                    if ring is not None:
+                        # Donating scatter: must not interleave with the learner's
+                        # dispatch reading the ring handle (see ring_lock above).
+                        with ring_lock:
+                            ring.add_step(
+                                {
+                                    "obs": np.concatenate(
+                                        [step_data[k].reshape(1, num_envs, -1) for k in mlp_keys], -1
+                                    ),
+                                    "next_obs": np.concatenate(
+                                        [step_data[f"next_{k}"].reshape(1, num_envs, -1) for k in mlp_keys],
+                                        -1,
+                                    ),
+                                    "actions": step_data["actions"],
+                                    "rewards": step_data["rewards"],
+                                    "dones": step_data["dones"],
+                                },
+                                rb._pos,
+                                rb.rows_added,
+                            )
                     rb.add(step_data, validate_args=cfg.buffer.validate_args)
                     obs = next_obs
                     policy_step += policy_steps_per_iter
@@ -197,7 +252,7 @@ def main(ctx, cfg) -> None:
                 batches = None
                 if iter_num >= learning_starts:
                     grad_steps = ratio((policy_step - prefill_iters * policy_steps_per_iter) / world)
-                    if grad_steps > 0:
+                    if grad_steps > 0 and ring is None:
                         sample = rb.sample(batch_size * grad_steps)
                         batches = {
                             "obs": np.concatenate(
@@ -230,6 +285,10 @@ def main(ctx, cfg) -> None:
                     "policy_step": policy_step,
                     "env_time": env_time,
                     "ckpt": ckpt_state,
+                    # Ring path: the learner samples in-jit; ship only the row
+                    # counters the sampler and the staleness stamps need.
+                    "filled": len(rb),
+                    "rows_added": rb.rows_added,
                 }
                 while not stop.is_set():
                     try:
@@ -256,7 +315,42 @@ def main(ctx, cfg) -> None:
             grad_steps = item["grad_steps"]
 
             train_time = 0.0
-            if grad_steps > 0:
+            if grad_steps > 0 and ring is not None:
+                with timer("Time/train_time"), monitor.phase("dispatch"):
+                    t0 = time.perf_counter()
+                    with ring_lock:
+                        carry = fused.dispatch(
+                            {"params": params, "opt_state": opt_state},
+                            ring.arrays,
+                            item["filled"],
+                            item["rows_added"],
+                            grad_steps,
+                            cumulative_grad_steps,
+                        )
+                    params, opt_state = carry["params"], carry["opt_state"]
+                    # Publish a COPY of the fresh actor: the next dispatch donates
+                    # ``params``, and the player must never read a donated buffer.
+                    try:
+                        param_q.get_nowait()
+                    except queue.Empty:
+                        pass
+                    param_q.put({"actor": jax.tree.map(jnp.copy, params["actor"])})
+                    with agg_lock:
+                        fused.drain(aggregator)  # one blocking device_get/iter, as before
+                    train_time = time.perf_counter() - t0
+                cumulative_grad_steps += grad_steps
+                if recorder is not None:
+                    # The pre-step state was DONATED into the block; re-stage
+                    # post-dispatch with a device-side copy (async, no host sync).
+                    recorder.stage_step(
+                        carry=jax.tree.map(jnp.copy, carry),
+                        scalars={
+                            "grad_step0": int(cumulative_grad_steps),
+                            "filled": int(item["filled"]),
+                            "rows_added": int(item["rows_added"]),
+                        },
+                    )
+            elif grad_steps > 0:
                 batches = ctx.put_batch(item["batches"], batch_axis=1)
                 key = ctx.rng()
                 if recorder is not None:  # device-array references only: no host sync

@@ -10,7 +10,6 @@ frequency conditionals."""
 
 from __future__ import annotations
 
-import contextlib
 import os
 import time
 from pathlib import Path
@@ -28,12 +27,12 @@ from sheeprl_tpu.algos.sac_ae.agent import build_agent, preprocess_obs
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.data.device_buffer import DeviceReplayMirror, device_replay_enabled
-from sheeprl_tpu.data.prefetch import AsyncBatchPrefetcher
+from sheeprl_tpu.data.device_buffer import make_transition_ring
+from sheeprl_tpu.data.prefetch import maybe_prefetcher
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.rollout import rollout_metrics
-from sheeprl_tpu.utils.blocks import WindowedFutures
+from sheeprl_tpu.utils.blocks import FusedRingDispatcher, WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, record_episode_stats
@@ -99,25 +98,25 @@ def main(ctx, cfg) -> None:
 
     # Device-resident replay (buffer.device=True): SAC-AE rows carry BOTH obs and
     # next-obs pixels, so the host path ships ~2× the Dreamer volume per batch —
-    # the HBM transition mirror removes that entirely (index-only sampling, in-jit
-    # [n, B] row gather).  The transition mirror is not shard_map'd, so the shared
-    # gate runs with allow_dp=False (DP falls back to the host prefetcher).
-    use_mirror = device_replay_enabled(ctx, cfg, allow_dp=False)
-    mirror = None
-    if use_mirror:
-        h, w = obs_space[cnn_keys[0]].shape[-2:]
-        c_total = sum(int(np.prod(obs_space[k].shape[:-2])) for k in cnn_keys)
-        mirror = DeviceReplayMirror(
-            rb.buffer_size,
-            num_envs,
-            {
-                "obs": ((c_total, h, w), jnp.uint8),
-                "next_obs": ((c_total, h, w), jnp.uint8),
-                "actions": ((act_dim,), jnp.float32),
-                "rewards": ((1,), jnp.float32),
-                "dones": ((1,), jnp.float32),
-            },
-        )
+    # the HBM transition ring removes that entirely, and the fused scanned block
+    # samples its indices IN-JIT from the carried PRNG key (one donated dispatch
+    # per gradient block, zero per-step host work).  The ring is not shard_map'd,
+    # so the shared gate runs with allow_dp=False (DP falls back to the host
+    # prefetcher) inside make_transition_ring.
+    h, w = obs_space[cnn_keys[0]].shape[-2:]
+    c_total = sum(int(np.prod(obs_space[k].shape[:-2])) for k in cnn_keys)
+    ring = make_transition_ring(
+        ctx,
+        cfg,
+        rb,
+        {
+            "obs": ((c_total, h, w), jnp.uint8),
+            "next_obs": ((c_total, h, w), jnp.uint8),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
 
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
@@ -275,8 +274,51 @@ def main(ctx, cfg) -> None:
             nan_scan(metrics, "sac_ae/train_fn")
         return p, o_state, metrics
 
-    # analysis.strict: signature guard on the jitted update (drift -> hard error)
+    # analysis.strict: signature guard on the jitted update (drift -> hard error).
+    # The fused ring block below inlines the RAW update (its outer jit carries the
+    # guard semantics via the dispatcher's fixed signature).
+    raw_train_fn = train_fn
     train_fn = strict_guard(cfg, "sac_ae/train_fn", train_fn)
+
+    futures = WindowedFutures()
+    fused = None
+    if ring is not None:
+        sample_gather = ring.make_sample_gather(batch_size)
+
+        def fused_builder(k, last):
+            def block(carry, arrays, filled, rows_added, base_key, start_count):
+                # Draw the whole [k, B] block IN-JIT (uniform index sampling off
+                # the carried key, HBM gather), then run the exact scanned update
+                # the host path jits — one donated dispatch either way.
+                counts = jnp.asarray(start_count, jnp.int32) + jnp.arange(k, dtype=jnp.int32)
+
+                def draw(count):
+                    return sample_gather(arrays, filled, rows_added, jax.random.fold_in(base_key, count))
+
+                batches, ages = jax.vmap(draw)(counts)
+                p, o_state, metrics = raw_train_fn(
+                    carry["params"],
+                    carry["opt_state"],
+                    batches,
+                    jax.random.fold_in(base_key, start_count),
+                    jnp.asarray(start_count, jnp.int32),
+                )
+                if health_enabled(cfg):  # staleness rides the deferred-metrics tree
+                    metrics = {
+                        **metrics,
+                        "Health/replay_age_mean": ages["Health/replay_age_mean"].mean(),
+                        "Health/replay_age_max": ages["Health/replay_age_max"].max(),
+                    }
+                return {"params": p, "opt_state": o_state}, metrics
+
+            return block
+
+        fused = FusedRingDispatcher(fused_builder, base_key=ctx.rng(), futures=futures)
+        # Donation safety: the target networks alias their online buffers at init
+        # (identity tree.map in build_agent) — a donated carry must not contain
+        # the same buffer twice.
+        params = jax.tree.map(jnp.copy, params)
+        opt_state = jax.tree.map(jnp.copy, opt_state)
 
     policy_steps_per_iter = num_envs * world
     total_steps = int(cfg.algo.total_steps)
@@ -301,15 +343,18 @@ def main(ctx, cfg) -> None:
         learning_starts += start_iter
         if cfg.buffer.checkpoint and "rb" in state:
             rb.load_state_dict(state["rb"])
-            if mirror is not None and len(rb) > 0:
-                mirror.load_from_dense(
+            if ring is not None and len(rb) > 0:
+                # The host buffer stays the source of truth: rebuild the HBM ring
+                # (and its staleness stamps) from the restored rows.
+                ring.load_from_transitions(
                     {
                         "obs": np.concatenate([rb[k] for k in cnn_keys], axis=2),
                         "next_obs": np.concatenate([rb[f"next_{k}"] for k in cnn_keys], axis=2),
                         "actions": rb["actions"],
                         "rewards": rb["rewards"],
                         "dones": rb["dones"],
-                    }
+                    },
+                    stamps=rb.row_stamps,
                 )
 
     def _img(o, idxs=None):
@@ -346,59 +391,53 @@ def main(ctx, cfg) -> None:
             batch_axis=1,
         )
 
-    if mirror is None and cfg.algo.get("async_prefetch", True):
-        prefetcher = AsyncBatchPrefetcher(_sample_block)
-        rb_lock = prefetcher.lock
-    else:
-        prefetcher, rb_lock = None, contextlib.nullcontext()
-    futures = WindowedFutures()
-
-    transition_gather = mirror.make_transition_gather_fn() if mirror is not None else None
-
-    @jax.jit
-    def train_fn_indexed(p, o_state, mirror_arrays, idxs, envs_i, key, step0):
-        # In-jit [n, B] row gather from the HBM mirror, then the same scan.
-        batches = transition_gather(mirror_arrays, idxs, envs_i)
-        return train_fn(p, o_state, batches, key, step0)
+    prefetcher, rb_lock = maybe_prefetcher(cfg, _sample_block, enabled=ring is None)
 
     recorder = flight_recorder.get_active()
 
     def _dispatch_train(grad_steps: int, stage_next: bool) -> None:
         nonlocal params, opt_state, cumulative_grad_steps
-        if mirror is not None:
-            idxs, envs_i = rb.sample_transition_idx(batch_size, grad_steps)
-            if recorder is not None:  # indices only on the mirror path (the ring
-                # itself is donated per scatter, so row refs cannot be staged)
+        if ring is not None:
+            # Fused device-ring block: ONE donated dispatch; even the index
+            # sampling runs in-jit off the carried key.
+            carry = fused.dispatch(
+                {"params": params, "opt_state": opt_state},
+                ring.arrays,
+                len(rb),
+                rb.rows_added,
+                grad_steps,
+                cumulative_grad_steps,
+            )
+            params, opt_state = carry["params"], carry["opt_state"]
+            cumulative_grad_steps += grad_steps
+            if recorder is not None:
+                # The pre-step state was DONATED into the block; re-stage
+                # post-dispatch with a device-side copy (async, no host sync).
                 recorder.stage_step(
-                    carry={"params": params, "opt_state": opt_state},
-                    scalars={"grad_step0": int(cumulative_grad_steps), "idxs": idxs.tolist(), "envs": envs_i.tolist()},
+                    carry=jax.tree.map(jnp.copy, carry),
+                    scalars={
+                        "grad_step0": int(cumulative_grad_steps),
+                        "filled": len(rb),
+                        "rows_added": rb.rows_added,
+                    },
                 )
-            params, opt_state, train_metrics = train_fn_indexed(
-                params,
-                opt_state,
-                mirror.arrays,
-                jnp.asarray(idxs, jnp.int32),
-                jnp.asarray(envs_i, jnp.int32),
-                ctx.rng(),
-                jnp.asarray(cumulative_grad_steps),
+            return
+        batches = (
+            prefetcher.get(grad_steps, stage_next=stage_next)
+            if prefetcher is not None
+            else _sample_block(grad_steps)
+        )
+        key = ctx.rng()
+        if recorder is not None:  # device-array references only: no host sync
+            recorder.stage_step(
+                batch=batches,
+                carry={"params": params, "opt_state": opt_state},
+                key=key,
+                scalars={"grad_step0": int(cumulative_grad_steps)},
             )
-        else:
-            batches = (
-                prefetcher.get(grad_steps, stage_next=stage_next)
-                if prefetcher is not None
-                else _sample_block(grad_steps)
-            )
-            key = ctx.rng()
-            if recorder is not None:  # device-array references only: no host sync
-                recorder.stage_step(
-                    batch=batches,
-                    carry={"params": params, "opt_state": opt_state},
-                    key=key,
-                    scalars={"grad_step0": int(cumulative_grad_steps)},
-                )
-            params, opt_state, train_metrics = train_fn(
-                params, opt_state, batches, key, jnp.asarray(cumulative_grad_steps)
-            )
+        params, opt_state, train_metrics = train_fn(
+            params, opt_state, batches, key, jnp.asarray(cumulative_grad_steps)
+        )
         futures.track(train_metrics, grad_steps)
         cumulative_grad_steps += grad_steps
 
@@ -448,8 +487,8 @@ def main(ctx, cfg) -> None:
             step_data["actions"] = tanh_actions.astype(np.float32)[None]
             step_data["rewards"] = np.asarray(reward, dtype=np.float32).reshape(num_envs, 1)[None]
             step_data["dones"] = terminated.astype(np.float32).reshape(num_envs, 1)[None]
-            if mirror is not None:
-                mirror.add(
+            if ring is not None:  # donated scatter at the host cursor, pre-add
+                ring.add_step(
                     {
                         "obs": np.concatenate([step_data[k] for k in cnn_keys], axis=2),
                         "next_obs": np.concatenate([step_data[f"next_{k}"] for k in cnn_keys], axis=2),
@@ -457,8 +496,8 @@ def main(ctx, cfg) -> None:
                         "rewards": step_data["rewards"],
                         "dones": step_data["dones"],
                     },
-                    list(range(num_envs)),
-                    [rb._pos] * num_envs,
+                    rb._pos,
+                    rb.rows_added,
                 )
             with rb_lock:
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)

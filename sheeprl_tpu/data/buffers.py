@@ -169,6 +169,19 @@ class ReplayBuffer:
             self._pos = new_pos % self._buffer_size
 
     # -- staleness ----------------------------------------------------------
+    @property
+    def rows_added(self) -> int:
+        """Cumulative rows ever added (the staleness clock).  The device-resident
+        transition ring (``data/device_buffer.py``) stamps its scatters with this
+        counter so in-jit ``Health/replay_age_*`` matches the host bookkeeping."""
+        return int(self._rows_added)
+
+    @property
+    def row_stamps(self) -> np.ndarray:
+        """Per-row write stamps in cumulative added-row units (read-only copy;
+        resume path of the device transition ring)."""
+        return self._stamps.copy()
+
     def _note_sample_ages(self, rows: np.ndarray) -> None:
         """Record the age distribution of the rows just sampled.  Age = rows added
         to this buffer since the sampled row was written (0 = freshest possible)."""

@@ -50,6 +50,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.parallel.mesh import shard_map_compat
+
 
 def gather_sequences(
     mirror: Dict[str, jax.Array],
@@ -153,7 +155,7 @@ class DeviceReplayMirror:
     def _make_scatter(self):
         if self.dp <= 1:
             return jax.jit(_masked_row_update, donate_argnums=(0,))
-        fn = jax.shard_map(
+        fn = shard_map_compat(
             _masked_row_update,
             mesh=self.mesh,
             in_specs=(P("data"), P("data"), P("data"), P("data")),
@@ -210,18 +212,35 @@ class DeviceReplayMirror:
             host[:, :rows] = np.moveaxis(src[:rows].reshape(rows, self.n_envs, self._flat[k]), 0, 1)
             self.arrays[k] = self._device(host)
 
-    def make_gather_fn(self, sequence_length: int):
+    def make_gather_fn(self, sequence_length: int, out_sharding=None):
         """The in-jit batch gather for :class:`~sheeprl_tpu.utils.blocks.
         IndexedBlockDispatcher`.  ``dp > 1``: shard-local gather via ``shard_map``
         — batch element ``j`` lives on the shard owning env ``envs[j]`` (the
         sharded sampler guarantees the alignment), and global env ids reduce to
         local ones by ``% E_local`` because each shard owns a contiguous env
         block.  Output ``[T, B, ...]`` is sharded over ``data`` on the batch axis,
-        identical to the host path's ``put_batch(..., batch_axis=1)``."""
+        identical to the host path's ``put_batch(..., batch_axis=1)``.
+
+        ``out_sharding``: optional ``[T, B, ...]`` batch sharding of the CONSUMING
+        train step, applied to every gathered leaf via ``with_sharding_constraint``.
+        Needed when the gather mesh is not the training mesh (e.g. the pure-DP
+        mirror mesh feeding a DP×TP train step): the gathered obs batch otherwise
+        carries the mirror's sharding into the train program as a constant, and
+        GSPMD only discovers the mismatch deep inside the BACKWARD pass (the obs
+        target of the reconstruction loss), where it logs an `[SPMD] Involuntary
+        full rematerialization` and replicates the tensor as a last resort.  An
+        explicit constraint at the gather boundary turns that into one clean
+        forward reshard instead."""
         shapes = self._row_shapes
         gather_mesh = self._gather_mesh()
+
+        def constrain(tree):
+            if out_sharding is None:
+                return tree
+            return jax.tree.map(lambda x: jax.lax.with_sharding_constraint(x, out_sharding), tree)
+
         if gather_mesh is None:
-            return lambda m, e, s: gather_sequences(m, e, s, sequence_length, row_shapes=shapes)
+            return lambda m, e, s: constrain(gather_sequences(m, e, s, sequence_length, row_shapes=shapes))
         # envs per shard — same count locally and globally (contiguous env blocks),
         # so global env ids reduce to shard-local rows by the same modulus.
         e_local = self.n_envs // max(self.dp, 1)
@@ -229,12 +248,13 @@ class DeviceReplayMirror:
         def local_gather(mirror, envs, starts):
             return gather_sequences(mirror, envs % e_local, starts, sequence_length, row_shapes=shapes)
 
-        return jax.shard_map(
+        sharded_gather = shard_map_compat(
             local_gather,
             mesh=gather_mesh,
             in_specs=(P("data"), P("data"), P("data")),
             out_specs=P(None, "data"),
         )
+        return lambda m, e, s: constrain(sharded_gather(m, e, s))
 
     def _gather_mesh(self):
         """Mesh the batch gather shard_maps over (None = unsharded single-device
@@ -262,6 +282,116 @@ class DeviceReplayMirror:
         accessor for the logical layout)."""
         arr = np.asarray(jax.device_get(self.arrays[key]))  # [n_envs, cap, flat]
         return np.moveaxis(arr, 0, 1).reshape(self.capacity, self.n_envs, *self._row_shapes[key])
+
+
+STAMP_KEY = "_stamp"
+
+
+class DeviceTransitionRing(DeviceReplayMirror):
+    """Device-resident uniform-replay ring for FLAT transition batches — the SAC
+    family's (sac / sac_decoupled / sac_ae / droq) analogue of the Dreamer loops'
+    sequence mirror.
+
+    Differences from the base mirror:
+
+    * rows are whole transitions (obs / next_obs / action / reward / done), so
+      sampling is a ``[B]`` row gather, not a ``[T, B]`` sequence gather;
+    * index sampling happens **inside the jit** from the train block's carried PRNG
+      key (:meth:`sample_indices` / :meth:`make_sample_gather`) — the host ships
+      only the ``filled`` row count, so a whole UTD block of gradient steps runs as
+      ONE dispatch with zero per-step host work;
+    * every scatter also stamps the written rows with the buffer's cumulative
+      added-row counter (``STAMP_KEY`` ring), so ``Health/replay_age_{mean,max}``
+      are computed in-jit and ride the block's metrics pytree — the host-side
+      ``sample_age_metrics`` path never runs on the device path.
+
+    Single-chip by design (the flat ring is not ``shard_map``'d); the shared
+    ``device_replay_enabled(..., allow_dp=False)`` gate falls back to host sampling
+    under data parallelism or multi-process meshes.
+    """
+
+    def __init__(self, capacity: int, n_envs: int, specs: Dict[str, Tuple[Sequence[int], Any]]):
+        specs = dict(specs)
+        if STAMP_KEY in specs:
+            raise ValueError(f"spec key {STAMP_KEY!r} is reserved for the ring's write stamps")
+        self._batch_keys = tuple(specs)
+        specs[STAMP_KEY] = ((1,), jnp.int32)
+        super().__init__(capacity, n_envs, specs)
+
+    def add_step(self, data: Dict[str, np.ndarray], position: int, rows_added: int) -> None:
+        """Scatter one transition row for EVERY env at ring slot ``position`` (the
+        host buffer's write cursor BEFORE its own add), donated in-place.
+        ``data[k]`` is ``[1, n_envs, ...]`` (the loops' step_data layout);
+        ``rows_added`` is the host buffer's cumulative added-row counter BEFORE the
+        add — it becomes the written rows' staleness stamp."""
+        pos = np.full(self.n_envs, int(position) % self.capacity, np.int32)
+        mask = np.ones(self.n_envs, bool)
+        rows = {}
+        for k in self._batch_keys:
+            rows[k] = np.ascontiguousarray(
+                np.asarray(data[k])[0].reshape(self.n_envs, self._flat[k]),
+                dtype=np.dtype(self.specs[k][1]),
+            )
+        rows[STAMP_KEY] = np.full((self.n_envs, 1), int(rows_added), np.int32)
+        self.arrays = self._scatter(self.arrays, rows, pos, mask)
+
+    def load_from_transitions(self, host_arrays: Dict[str, np.ndarray], stamps: Optional[np.ndarray] = None) -> None:
+        """Rebuild from dense ``[cap, n_envs, ...]`` host arrays (resume path:
+        the ``ReplayBuffer`` storage is already ring-shaped).  ``stamps`` is the
+        host buffer's per-row stamp vector (``ReplayBuffer.row_stamps``), shared
+        across envs — restores sensible ``Health/replay_age_*`` after a resume."""
+        for k in self._batch_keys:
+            src = np.asarray(host_arrays[k])
+            rows = min(src.shape[0], self.capacity)
+            host = np.zeros(self.arrays[k].shape, self.specs[k][1])
+            host[:, :rows] = np.moveaxis(src[:rows].reshape(rows, self.n_envs, self._flat[k]), 0, 1)
+            self.arrays[k] = self._device(host)
+        st = np.zeros(self.arrays[STAMP_KEY].shape, np.int32)
+        if stamps is not None:
+            rows = min(len(stamps), self.capacity)
+            st[:, :rows, 0] = np.asarray(stamps[:rows], np.int64)
+        self.arrays[STAMP_KEY] = self._device(st)
+
+    def sample_indices(self, filled, key, batch_size: int):
+        """The exact in-jit uniform index draw the fused train blocks run: ``[B]``
+        (env, row) int32 pairs, rows uniform over ``[0, filled)`` and envs uniform
+        over ``[0, n_envs)`` — the same distribution as the host buffer's
+        ``sample()`` (jittable; deterministic under a fixed key)."""
+        k_row, k_env = jax.random.split(key)
+        rows = jax.random.randint(k_row, (batch_size,), 0, jnp.maximum(filled, 1), dtype=jnp.int32)
+        envs = jax.random.randint(k_env, (batch_size,), 0, self.n_envs, dtype=jnp.int32)
+        return envs, rows
+
+    def make_sample_gather(self, batch_size: int):
+        """``closure(arrays, filled, rows_added, key) -> (batch, age_metrics)``:
+        in-jit uniform sampling + HBM row gather + staleness stats, for use inside
+        a scanned train block.  ``batch[k]`` is ``[B, *row_shape]``."""
+        shapes = {k: self._row_shapes[k] for k in self._batch_keys}
+        batch_keys = self._batch_keys
+
+        def sample_gather(arrays, filled, rows_added, key):
+            envs, rows = self.sample_indices(filled, key, batch_size)
+            batch = {}
+            for k in batch_keys:
+                picked = arrays[k][envs, rows]  # [B, flat]
+                batch[k] = picked.reshape(batch_size, *shapes[k])
+            ages = (rows_added - 1) - arrays[STAMP_KEY][envs, rows, 0]
+            age_metrics = {
+                "Health/replay_age_mean": jnp.mean(ages).astype(jnp.float32),
+                "Health/replay_age_max": jnp.max(ages).astype(jnp.float32),
+            }
+            return batch, age_metrics
+
+        return sample_gather
+
+
+def make_transition_ring(ctx, cfg, rb, specs: Dict[str, Tuple[Sequence[int], Any]]):
+    """The SAC family's ``buffer.device`` wiring: a :class:`DeviceTransitionRing`
+    when the shared gate admits it (single chip, no DP), else ``None`` (the loops
+    then keep host sampling + the async prefetcher)."""
+    if not device_replay_enabled(ctx, cfg, allow_dp=False):
+        return None
+    return DeviceTransitionRing(rb.buffer_size, rb.n_envs, specs)
 
 
 def _data_axis_devices(mesh) -> list:
@@ -571,9 +701,13 @@ def make_device_replay(
             ctx=ctx,
         )
         multiprocess = isinstance(mirror, MultiProcessDeviceReplayMirror)
+        # Pin the gathered batch to the TRAIN mesh's batch sharding: when the
+        # mirror's (pure-DP) mesh differs from the training mesh, the reshard
+        # happens once at the gather boundary instead of as an involuntary full
+        # rematerialization inside the backward pass (see make_gather_fn).
         dispatcher = IndexedBlockDispatcher(
             step_fn,
-            gather_fn=mirror.make_gather_fn(seq_len),
+            gather_fn=mirror.make_gather_fn(seq_len, out_sharding=ctx.sharding(None, "data")),
             globalize=mirror.globalize_indices if multiprocess else None,
             **kwargs,
         )

@@ -97,6 +97,22 @@ class AsyncBatchPrefetcher:
             pass
 
 
+def maybe_prefetcher(cfg, sample_fn: Callable[[int], Any], slice_fn=None, enabled: bool = True):
+    """The SAC-family loops' prefetcher gate: ``(prefetcher_or_None, rb_lock)``.
+
+    ``enabled=False`` (the device-resident transition ring is active — see
+    ``data/device_buffer.py``) skips the prefetcher entirely: sampling happens
+    inside the fused train block, so there is nothing to stage host-side.  Loops
+    must still take the returned lock around ``rb.add`` (a null context when no
+    worker thread exists)."""
+    import contextlib
+
+    if enabled and cfg.algo.get("async_prefetch", True):
+        prefetcher = AsyncBatchPrefetcher(sample_fn, slice_fn=slice_fn)
+        return prefetcher, prefetcher.lock
+    return None, contextlib.nullcontext()
+
+
 def make_replay_prefetcher(rb, ctx, cfg, batch_size: int, sequence_length: int):
     """The training loops' standard setup: a sampler closure drawing ``n`` gradient
     steps' worth of ``[T, B]`` batches, wrapped in a prefetcher when

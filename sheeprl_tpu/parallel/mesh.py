@@ -30,6 +30,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _distributed_initialized = False
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """``jax.shard_map`` moved out of ``jax.experimental`` only in newer releases;
+    dispatch to whichever spelling this jax has so shard_map consumers (the
+    sharded replay mirror, ring attention) work on both (0.4.x ships
+    ``jax.experimental.shard_map.shard_map`` only)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
 def maybe_init_distributed(mesh_cfg: Dict[str, Any]) -> None:
     """Initialise multi-host JAX when requested (replaces Fabric ``num_nodes``).
     Takes the ``mesh`` sub-config (not the root config).  Idempotent:

@@ -213,6 +213,86 @@ class BlockDispatcher:
         return self._futures.pop_window_sps()
 
 
+class FusedRingDispatcher:
+    """Dispatcher for the SAC family's fused scanned update blocks over the
+    device-resident transition ring (``data/device_buffer.py``).
+
+    Where :class:`IndexedBlockDispatcher` still ships host-sampled ``[G, B]``
+    index arrays, here even the index sampling happens INSIDE the jit from the
+    carried PRNG key: the host passes only the ring handle, the filled-row count
+    and the cumulative step counters, so a whole K-step UTD block (DroQ: 20 critic
+    updates + the actor update) is ONE dispatch with zero per-step host work.
+
+    ``block_builder(k, last)`` returns the python block function for a ``k``-step
+    chunk; ``last`` marks the chunk that closes the iteration's block (DroQ runs
+    its once-per-iteration actor update only there — builders without per-block
+    tails ignore it, and ``last_sensitive=False`` caches on ``k`` alone).  Blocks
+    are jitted with ``donate_argnums=(0,)``: the carry (params + optimizer state)
+    is donated and updated in place — callers MUST rebind the carry from the
+    return value and never reuse a pre-dispatch reference (jaxlint JL005).
+
+    Program-count bound: each distinct ``k`` compiles once and is dispatched
+    exactly K→1; once ``max_programs`` distinct sizes exist, new irregular sizes
+    decompose into cached powers of two (:func:`chunk_sizes`) instead of
+    compiling more programs.  The steady-state Ratio/UTD count is constant, so
+    real runs stay at one program (plus the pretrain burst's chunks).
+    """
+
+    def __init__(
+        self,
+        block_builder: Callable,
+        base_key=None,
+        max_programs: int = 8,
+        max_chunk: int = 8,
+        last_sensitive: bool = False,
+        futures: "WindowedFutures" = None,
+    ):
+        self._builder = block_builder
+        self._blocks: dict = {}
+        self._base_key = base_key
+        self._max_programs = max_programs
+        self._max_chunk = max_chunk
+        self._last_sensitive = last_sensitive
+        # Loops that mix host/device paths pass their own WindowedFutures so one
+        # drain covers whichever path dispatched.
+        self._futures = futures if futures is not None else WindowedFutures()
+        # dispatches() counts jit calls — the parity tests assert K→1 per block.
+        self.dispatch_count = 0
+
+    def _plan(self, n: int) -> List[int]:
+        if n <= 0:
+            return []
+        if any(k == n for (k, _) in self._blocks) or len(self._blocks) < self._max_programs:
+            return [n]
+        return chunk_sizes(n, self._max_chunk)
+
+    def _get(self, k: int, last: bool):
+        cache_key = (k, last if self._last_sensitive else True)
+        block = self._blocks.get(cache_key)
+        if block is None:
+            block = jax.jit(self._builder(k, cache_key[1]), donate_argnums=(0,))
+            self._blocks[cache_key] = block
+        return block
+
+    def dispatch(self, carry, ring_arrays: dict, filled: int, rows_added: int, n: int, start_count: int):
+        """Run ``n`` gradient steps as one fused block (or cached-size chunks);
+        returns the new carry.  Nothing blocks here — metrics stay device futures."""
+        sizes = self._plan(n)
+        for i, size in enumerate(sizes):
+            block = self._get(size, i == len(sizes) - 1)
+            carry, metrics = block(carry, ring_arrays, filled, rows_added, self._base_key, start_count)
+            self.dispatch_count += 1
+            start_count += size
+            self._futures.track(metrics, size)
+        return carry
+
+    def drain(self, aggregator) -> None:
+        self._futures.drain(aggregator)
+
+    def pop_window_sps(self):
+        return self._futures.pop_window_sps()
+
+
 class IndexedBlockDispatcher:
     """BlockDispatcher variant for the device-resident replay mirror
     (``data/device_buffer.py``): the host ships only ``[G, B]`` (env, start) index

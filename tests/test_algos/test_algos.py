@@ -468,6 +468,66 @@ def test_p2e_dv3_device_buffer_exploration_and_finetuning(tmp_path):
     assert len(_ckpts(tmp_path)) > len(ckpts)
 
 
+def test_sac_device_buffer_resume(tmp_path):
+    """buffer.device=True on SAC: HBM transition ring + fused scanned blocks with
+    in-jit index sampling and a donated carry; resume rebuilds the ring (and its
+    staleness stamps) from the checkpointed host buffer."""
+    dev = ["buffer.device=True", "mesh.devices=1"]
+    run(SAC_ARGS + dev + standard_args(tmp_path, extra=["dry_run=False"]))
+    ckpts = _ckpts(tmp_path)
+    assert ckpts, "no checkpoint written"
+    run(
+        SAC_ARGS
+        + dev
+        + [f"checkpoint.resume_from={ckpts[-1]}", "algo.total_steps=24"]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+
+
+def test_droq_device_buffer(tmp_path):
+    """buffer.device=True on DroQ: the UTD block (K critic updates + actor update)
+    runs as ONE fused donated dispatch over the HBM transition ring."""
+    run(
+        [
+            "exp=droq",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=8",
+            "algo.learning_starts=4",
+            "algo.total_steps=16",
+            "buffer.size=256",
+            "buffer.device=True",
+            "mesh.devices=1",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    assert _ckpts(tmp_path), "no checkpoint written"
+
+
+@pytest.mark.slow
+def test_sac_decoupled_device_buffer(tmp_path):
+    """buffer.device=True on decoupled SAC: the player scatters into the ring
+    while the learner runs fused donated blocks; the player acts on copied params
+    so donation never invalidates its actor."""
+    run(
+        [
+            "exp=sac_decoupled",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=8",
+            "algo.learning_starts=4",
+            "algo.total_steps=16",
+            "buffer.size=256",
+            "buffer.device=True",
+            "mesh.devices=1",
+        ]
+        + standard_args(tmp_path, extra=["dry_run=False"])
+    )
+    assert _ckpts(tmp_path), "no checkpoint written"
+
+
 def test_sac_ae_device_buffer(tmp_path):
     """buffer.device=True on SAC-AE: HBM transition mirror (obs+next_obs rows),
     index-only sampling, in-jit row gather."""

@@ -1,0 +1,272 @@
+"""Fused scanned update blocks over the device-resident transition ring
+(``data/device_buffer.py`` + ``utils/blocks.FusedRingDispatcher``).
+
+CPU parity proof required by the device-replay work: a scanned K-step block must
+be BIT-IDENTICAL to K sequential dispatches (per-step keys derive from
+``fold_in(base_key, cumulative_step)``, so any chunk decomposition reproduces the
+fused whole), and the dispatcher must issue exactly ONE jit call per block
+(K→1 dispatch reduction).
+"""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.config.core import compose
+from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
+from sheeprl_tpu.utils.blocks import FusedRingDispatcher
+
+OBS_DIM, ACT_DIM, BATCH = 5, 2, 4
+
+
+def _ctx():
+    return MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+
+
+def _spaces():
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-1.0, 1.0, (OBS_DIM,), np.float32)})
+    act_space = gym.spaces.Box(-1.0, 1.0, (ACT_DIM,), np.float32)
+    return obs_space, act_space
+
+
+def _ring(n_envs=2, cap=32, steps=20, seed=0):
+    rng = np.random.default_rng(seed)
+    ring = DeviceTransitionRing(
+        cap,
+        n_envs,
+        {
+            "obs": ((OBS_DIM,), jnp.float32),
+            "next_obs": ((OBS_DIM,), jnp.float32),
+            "actions": ((ACT_DIM,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+    for t in range(steps):
+        ring.add_step(
+            {
+                "obs": rng.random((1, n_envs, OBS_DIM)).astype(np.float32),
+                "next_obs": rng.random((1, n_envs, OBS_DIM)).astype(np.float32),
+                "actions": rng.random((1, n_envs, ACT_DIM)).astype(np.float32),
+                "rewards": rng.random((1, n_envs, 1)).astype(np.float32),
+                "dones": np.zeros((1, n_envs, 1), np.float32),
+            },
+            t % cap,
+            t,
+        )
+    return ring, min(steps, cap), steps
+
+
+def _copy(tree):
+    """Independent deep copy: dispatches DONATE the carry, so each compared path
+    needs its own buffers (donation is live even on the virtual CPU mesh)."""
+    return jax.tree.map(jnp.copy, tree)
+
+
+def _assert_trees_equal(a, b, what):
+    for pa, la in zip(jax.tree_util.tree_leaves_with_path(a), jax.tree.leaves(b)):
+        path, leaf_a = pa
+        np.testing.assert_array_equal(
+            np.asarray(leaf_a), np.asarray(la), err_msg=f"{what}: {jax.tree_util.keystr(path)}"
+        )
+
+
+def test_sac_fused_block_bit_identical_to_sequential():
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.algos.sac.sac import make_sac_fused_builder
+
+    cfg = compose(
+        overrides=[
+            "exp=sac",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            f"algo.per_rank_batch_size={BATCH}",
+        ]
+    )
+    ctx = _ctx()
+    obs_space, act_space = _spaces()
+    ring, filled, rows_added = _ring()
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    actor_opt, critic_opt, alpha_opt, builder = make_sac_fused_builder(
+        actor, critic, cfg, act_space, ring, BATCH
+    )
+    opt_state = {
+        "actor": actor_opt.init(params["actor"]),
+        "critic": critic_opt.init(params["critic"]),
+        "alpha": alpha_opt.init(params["log_alpha"]),
+    }
+    carry0 = {"params": params, "opt_state": opt_state}
+    base_key = jax.random.PRNGKey(11)
+    K = 5
+
+    fused = FusedRingDispatcher(builder, base_key=base_key)
+    carry_fused = fused.dispatch(_copy(carry0), ring.arrays, filled, rows_added, K, 0)
+    # The whole K-step block (sampling + K updates + EMA cadence) is ONE dispatch.
+    assert fused.dispatch_count == 1
+
+    seq = FusedRingDispatcher(builder, base_key=base_key)
+    carry_seq = _copy(carry0)
+    for g in range(K):
+        carry_seq = seq.dispatch(carry_seq, ring.arrays, filled, rows_added, 1, g)
+    assert seq.dispatch_count == K
+
+    _assert_trees_equal(carry_fused, carry_seq, "sac fused-vs-sequential train state")
+
+
+def test_sac_fused_block_chunk_decomposition_bit_identical():
+    """Once the program cache is full, irregular sizes chunk into cached powers of
+    two — the per-step fold_in key derivation keeps that bit-identical too."""
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.algos.sac.sac import make_sac_fused_builder
+
+    cfg = compose(
+        overrides=[
+            "exp=sac",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            f"algo.per_rank_batch_size={BATCH}",
+        ]
+    )
+    ctx = _ctx()
+    obs_space, act_space = _spaces()
+    ring, filled, rows_added = _ring(seed=1)
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    actor_opt, critic_opt, alpha_opt, builder = make_sac_fused_builder(
+        actor, critic, cfg, act_space, ring, BATCH
+    )
+    opt_state = {
+        "actor": actor_opt.init(params["actor"]),
+        "critic": critic_opt.init(params["critic"]),
+        "alpha": alpha_opt.init(params["log_alpha"]),
+    }
+    carry0 = {"params": params, "opt_state": opt_state}
+    base_key = jax.random.PRNGKey(3)
+    K = 5
+
+    fused = FusedRingDispatcher(builder, base_key=base_key)
+    carry_fused = fused.dispatch(_copy(carry0), ring.arrays, filled, rows_added, K, 0)
+
+    # max_programs=1: after the first (2-step) program is cached, K=5 cannot
+    # compile a new size and decomposes into power-of-two chunks instead.
+    chunked = FusedRingDispatcher(builder, base_key=base_key, max_programs=1, max_chunk=4)
+    warm = chunked.dispatch(_copy(carry0), ring.arrays, filled, rows_added, 2, 0)
+    del warm
+    assert list(chunked._blocks) == [(2, True)]
+    carry_chunked = chunked.dispatch(_copy(carry0), ring.arrays, filled, rows_added, K, 0)
+    assert chunked.dispatch_count > 2  # the K=5 block went out as several chunks
+    assert all(k in (1, 2, 4) for (k, _) in chunked._blocks)
+
+    _assert_trees_equal(carry_fused, carry_chunked, "sac fused-vs-chunked train state")
+
+
+def test_droq_fused_block_bit_identical_and_one_dispatch():
+    """DroQ's whole UTD block — K critic updates + the once-per-iteration actor
+    update — is ONE dispatch, bit-identical to K critic-only dispatches followed
+    by the actor tail."""
+    from sheeprl_tpu.algos.droq.droq import DroQCriticEnsemble, make_droq_fused_builder
+    from sheeprl_tpu.algos.sac.agent import SACActor
+
+    cfg = compose(
+        overrides=[
+            "exp=droq",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            f"algo.per_rank_batch_size={BATCH}",
+        ]
+    )
+    ctx = _ctx()
+    obs_space, act_space = _spaces()
+    ring, filled, rows_added = _ring(seed=2)
+
+    actor = SACActor(act_dim=ACT_DIM, hidden_size=cfg.algo.actor.hidden_size, dtype=ctx.compute_dtype)
+    critic = DroQCriticEnsemble(
+        n_critics=cfg.algo.critic.n,
+        hidden_size=cfg.algo.critic.hidden_size,
+        dropout=cfg.algo.critic.dropout,
+        dtype=ctx.compute_dtype,
+    )
+    dummy_obs, dummy_act = jnp.zeros((1, OBS_DIM)), jnp.zeros((1, ACT_DIM))
+    params = {
+        "actor": actor.init(ctx.rng(), dummy_obs),
+        "critic": critic.init({"params": ctx.rng(), "dropout": ctx.rng()}, dummy_obs, dummy_act),
+        "log_alpha": jnp.asarray(jnp.log(cfg.algo.alpha.alpha), dtype=jnp.float32),
+    }
+    params["critic_target"] = jax.tree.map(jnp.copy, params["critic"])
+
+    actor_opt, critic_opt, alpha_opt, builder = make_droq_fused_builder(
+        actor, critic, cfg, act_space, ring, BATCH
+    )
+    opt_state = {
+        "actor": actor_opt.init(params["actor"]),
+        "critic": critic_opt.init(params["critic"]),
+        "alpha": alpha_opt.init(params["log_alpha"]),
+    }
+    carry0 = {"params": params, "opt_state": opt_state}
+    base_key = jax.random.PRNGKey(17)
+    K = 4
+
+    fused = FusedRingDispatcher(builder, base_key=base_key, last_sensitive=True)
+    carry_fused = fused.dispatch(_copy(carry0), ring.arrays, filled, rows_added, K, 0)
+    # 20-critic-updates-+-actor-per-dispatch is the whole point: ONE jit call.
+    assert fused.dispatch_count == 1
+
+    # Sequential reference: K critic-only chunks, then the actor tail at the
+    # block-closing cumulative count (the key-derivation contract).  Donated like
+    # the dispatcher's blocks — donation changes XLA's compiled program, so a
+    # non-donated reference would drift by one ulp.
+    critic_block = jax.jit(builder(1, False), donate_argnums=(0,))
+    actor_tail = jax.jit(builder(0, True), donate_argnums=(0,))
+    carry_seq = _copy(carry0)
+    for g in range(K):
+        carry_seq, _ = critic_block(carry_seq, ring.arrays, filled, rows_added, base_key, g)
+    carry_seq, _ = actor_tail(carry_seq, ring.arrays, filled, rows_added, base_key, K)
+
+    _assert_trees_equal(carry_fused, carry_seq, "droq fused-vs-sequential train state")
+
+
+def test_fused_block_metrics_carry_replay_age():
+    """Health/replay_age_* are computed IN-JIT from the ring's stamp plane and ride
+    the block's metrics pytree (no host-side sampling happens on the ring path)."""
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.algos.sac.sac import make_sac_fused_builder
+
+    cfg = compose(
+        overrides=[
+            "exp=sac",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            f"algo.per_rank_batch_size={BATCH}",
+        ]
+    )
+    ctx = _ctx()
+    obs_space, act_space = _spaces()
+    ring, filled, rows_added = _ring(seed=4)
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    actor_opt, critic_opt, alpha_opt, builder = make_sac_fused_builder(
+        actor, critic, cfg, act_space, ring, BATCH
+    )
+    opt_state = {
+        "actor": actor_opt.init(params["actor"]),
+        "critic": critic_opt.init(params["critic"]),
+        "alpha": alpha_opt.init(params["log_alpha"]),
+    }
+    block = jax.jit(builder(2, True))
+    _, metrics = block(
+        {"params": params, "opt_state": opt_state},
+        ring.arrays,
+        filled,
+        rows_added,
+        jax.random.PRNGKey(0),
+        0,
+    )
+    assert "Health/replay_age_mean" in metrics and "Health/replay_age_max" in metrics
+    assert 0.0 <= float(metrics["Health/replay_age_mean"]) <= float(metrics["Health/replay_age_max"])
+    assert float(metrics["Health/replay_age_max"]) <= rows_added - 1
+    for k in ("Loss/value_loss", "Loss/policy_loss", "Loss/alpha_loss"):
+        assert k in metrics

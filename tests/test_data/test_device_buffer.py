@@ -5,8 +5,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.device_buffer import DeviceReplayMirror
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, ReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.device_buffer import STAMP_KEY, DeviceReplayMirror, DeviceTransitionRing
 
 
 def _row(rng, n_envs, t):
@@ -125,3 +125,91 @@ def test_mirror_load_from_resume():
     dev = mirror.host_rows("rewards")
     for e in range(n_envs):
         np.testing.assert_array_equal(dev[:5, e, 0], np.arange(5, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# DeviceTransitionRing (SAC family): donated scatter, in-jit uniform sampling
+# from a fixed key, and in-jit staleness — all bit-identical to the host buffer.
+# ---------------------------------------------------------------------------
+
+def _transition_row(rng, n_envs, t):
+    return {
+        "obs": rng.random((1, n_envs, 5)).astype(np.float32),
+        "next_obs": rng.random((1, n_envs, 5)).astype(np.float32),
+        "actions": rng.random((1, n_envs, 2)).astype(np.float32),
+        "rewards": np.full((1, n_envs, 1), float(t), np.float32),
+        "dones": np.zeros((1, n_envs, 1), np.float32),
+    }
+
+
+def _transition_specs():
+    return {
+        "obs": ((5,), jnp.float32),
+        "next_obs": ((5,), jnp.float32),
+        "actions": ((2,), jnp.float32),
+        "rewards": ((1,), jnp.float32),
+        "dones": ((1,), jnp.float32),
+    }
+
+
+def _filled_ring(n_envs=3, cap=16, steps=25, seed=0):
+    """Host ReplayBuffer + DeviceTransitionRing fed the same rows (wrapping)."""
+    rng = np.random.default_rng(seed)
+    rb = ReplayBuffer(cap, n_envs, obs_keys=("obs",))
+    rb.seed(seed)
+    ring = DeviceTransitionRing(cap, n_envs, _transition_specs())
+    for t in range(steps):
+        row = _transition_row(rng, n_envs, t)
+        ring.add_step(row, rb._pos, rb.rows_added)
+        rb.add(row)
+    return rb, ring
+
+
+def test_transition_ring_matches_host_rows():
+    n_envs, cap = 3, 16
+    rb, ring = _filled_ring(n_envs, cap)
+    for k in ("obs", "next_obs", "actions", "rewards", "dones"):
+        dev = ring.host_rows(k)  # [cap, n_envs, *row_shape]
+        np.testing.assert_array_equal(dev, rb[k], err_msg=k)
+    # Write stamps match the host buffer's staleness bookkeeping row for row.
+    stamps = ring.host_rows(STAMP_KEY)[:, :, 0]  # [cap, n_envs]
+    for e in range(n_envs):
+        np.testing.assert_array_equal(stamps[:, e], rb.row_stamps)
+
+
+def test_transition_ring_in_jit_sampling_bit_identical_to_host():
+    """Fixed key -> the in-jit sampled batch equals a host-side numpy gather at the
+    indices the same computation produces, bit for bit — and is deterministic."""
+    n_envs, cap, batch = 3, 16, 8
+    rb, ring = _filled_ring(n_envs, cap)
+    key = jax.random.PRNGKey(7)
+    filled = len(rb)
+
+    envs, rows = jax.jit(lambda f, k: ring.sample_indices(f, k, batch))(filled, key)
+    sample_gather = ring.make_sample_gather(batch)
+    batch1, ages1 = jax.jit(sample_gather)(ring.arrays, filled, rb.rows_added, key)
+    batch2, _ = jax.jit(sample_gather)(ring.arrays, filled, rb.rows_added, key)
+
+    envs, rows = np.asarray(envs), np.asarray(rows)
+    assert rows.max() < filled
+    for k in ("obs", "next_obs", "actions", "rewards", "dones"):
+        host = rb[k][rows, envs]  # host storage is [cap, n_envs, ...]
+        np.testing.assert_array_equal(np.asarray(batch1[k]), host, err_msg=k)
+        np.testing.assert_array_equal(np.asarray(batch1[k]), np.asarray(batch2[k]))
+
+    # In-jit staleness == the host buffer's definition (age = rows_added-1 - stamp).
+    expect_ages = (rb.rows_added - 1) - rb.row_stamps[rows]
+    assert float(ages1["Health/replay_age_mean"]) == expect_ages.mean()
+    assert float(ages1["Health/replay_age_max"]) == expect_ages.max()
+
+
+def test_transition_ring_resume_rebuild():
+    n_envs, cap = 2, 8
+    rb, ring = _filled_ring(n_envs, cap, steps=11, seed=3)
+    rebuilt = DeviceTransitionRing(cap, n_envs, _transition_specs())
+    rebuilt.load_from_transitions(
+        {k: rb[k] for k in ("obs", "next_obs", "actions", "rewards", "dones")},
+        stamps=rb.row_stamps,
+    )
+    for k in ("obs", "next_obs", "actions", "rewards", "dones", STAMP_KEY):
+        np.testing.assert_array_equal(rebuilt.host_rows(k), ring.host_rows(k), err_msg=k)

@@ -1,0 +1,51 @@
+"""Tier-1 smoke of benchmarks/replay_bench.py: tiny-shape invocation of all three
+replay data paths (host-per-step / host-block / device-ring fused), JSON rows
+compatible with the BENCH_*.json trajectory."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _load_bench_module():
+    bench_dir = os.path.join(os.path.dirname(__file__), "..", "..", "benchmarks")
+    sys.path.insert(0, os.path.abspath(bench_dir))
+    try:
+        import replay_bench
+    finally:
+        sys.path.pop(0)
+    return replay_bench
+
+
+def test_replay_bench_smoke(capsys, tmp_path):
+    replay_bench = _load_bench_module()
+    out_path = tmp_path / "replay_bench.json"
+    rates = replay_bench.main(
+        [
+            "--batch", "8",
+            "--hidden", "8",
+            "--blocks", "2",
+            "--utd", "3",
+            "--algos", "sac,droq",
+            "--json-out", str(out_path),
+        ]
+    )
+    assert set(rates) == {"sac", "droq"}
+    for algo in ("sac", "droq"):
+        assert set(rates[algo]) == {"host_per_step", "host_block", "device_ring"}
+        assert all(v > 0 for v in rates[algo].values())
+
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip().startswith("{")]
+    rows = [json.loads(ln) for ln in lines]
+    metrics = {r["metric"] for r in rows}
+    for algo in ("sac", "droq"):
+        assert f"{algo}_replay_device_ring_grad_steps_per_sec" in metrics
+        assert f"{algo}_replay_device_ring_speedup_vs_per_step" in metrics
+    for r in rows:
+        assert {"metric", "value", "unit"} <= set(r)
+        assert isinstance(r["value"], (int, float))
+
+    saved = json.loads(out_path.read_text())
+    assert [r["metric"] for r in saved] == [r["metric"] for r in rows]
