@@ -244,9 +244,40 @@ def bench_droq_utd20() -> dict:
     }
 
 
+def bench_anakin() -> list:
+    """Anakin fused-scan rows (``benchmarks/anakin_bench.py``): on-device jax
+    CartPole env-steps/s vs the host ``SyncVectorEnv`` path, plus the fused PPO
+    collect+update grad-steps/s.  Set ``BENCH_ANAKIN=0`` to skip."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    try:
+        import anakin_bench
+    finally:
+        sys.path.pop(0)
+    argv = [
+        "--num-envs", os.environ.get("BENCH_ANAKIN_ENVS", "1024"),
+        "--iters", os.environ.get("BENCH_ANAKIN_ITERS", "8"),
+    ]
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        anakin_bench.main(argv)
+    return [json.loads(line) for line in buf.getvalue().splitlines() if line.strip()]
+
+
 def main() -> None:
-    # DroQ UTD-20 fused-block row first: the collector parses the LAST JSON line
-    # as the headline metric, and bench_compare.py picks up every row in the tail.
+    # Anakin fused-scan rows first (ISSUE-6): the collector parses the LAST JSON
+    # line as the headline metric, so auxiliary rows print before it.
+    if os.environ.get("BENCH_ANAKIN", "1") != "0":
+        try:
+            for row in bench_anakin():
+                print(json.dumps(row))
+        except Exception as exc:
+            print(json.dumps({"metric": "anakin_cartpole_steps_per_sec", "error": str(exc)[:200]}))
+    # DroQ UTD-20 fused-block row: same auxiliary-row contract.
     if os.environ.get("BENCH_DROQ", "1") != "0":
         try:
             print(json.dumps(bench_droq_utd20()))

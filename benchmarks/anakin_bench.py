@@ -1,0 +1,236 @@
+"""Anakin throughput benchmark: on-device fused-scan env stepping + training vs
+the host vector-env path, on the pure-JAX CartPole (ISSUE-6 / ROADMAP item 1).
+
+Three measurements, each a BENCH-style JSON row on stdout (feeds
+``benchmarks/bench_compare.py``; all rows are higher-better):
+
+* ``anakin_cartpole_steps_per_sec`` — raw env-steps/s of N vmapped
+  :class:`~sheeprl_tpu.envs.jax.cartpole.CartPole` instances auto-reset-stepping
+  inside one jitted ``lax.scan`` (random actions drawn in-jit).  Two host
+  baselines ride as extras, both stepping gymnasium ``CartPole-v1``:
+  ``host_sync_vector_steps_per_sec`` is THE path the training loops pay today —
+  the repo's own ``make_vector_env`` ``SyncVectorEnv`` wrapper stack (dict-obs
+  coercion, episode statistics, TimeLimit) at the presets' default env count
+  (``--host-envs``, default 4) — so ``speedup_vs_host`` is ROADMAP item 1's
+  "100-1000x current env throughput" acceptance row; ``host_raw_gym_saturated``
+  is bare ``gym.make`` under ``SyncVectorEnv`` at a saturating env count (the
+  python step loop plateaus near 90k steps/s on this class of machine no matter
+  how many envs — exactly the single-core wall the Anakin mode removes), with
+  ``speedup_vs_raw_gym_saturated`` the conservative lower bound;
+* ``anakin_ppo_grad_steps_per_sec`` — grad-steps/s of the FULL fused PPO
+  iteration (collection scan + GAE + the scanned minibatch update, ONE donated
+  dispatch per iteration), with the implied env-steps/s as an extra.
+
+Usage::
+
+    python benchmarks/anakin_bench.py
+    python benchmarks/anakin_bench.py --num-envs 64 --steps 4096 --host-steps 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("SHEEPRL_TPU_QUIET", "1")
+
+import gymnasium as gym  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from sheeprl_tpu.config.core import compose  # noqa: E402
+from sheeprl_tpu.envs.jax import make_jax_env  # noqa: E402
+from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh  # noqa: E402
+
+
+def _time_vector_env(envs, num_envs: int, steps: int, seed: int = 0) -> float:
+    envs.reset(seed=seed)
+    rng = np.random.default_rng(seed)
+    actions = rng.integers(0, 2, (steps, num_envs))
+    t0 = time.perf_counter()
+    for t in range(steps):
+        envs.step(actions[t])
+    elapsed = time.perf_counter() - t0
+    envs.close()
+    return steps * num_envs / elapsed
+
+
+def bench_host_sync_vector(num_envs: int, steps: int, seed: int = 0) -> float:
+    """Env-steps/s of the host path the training loops ACTUALLY pay: gymnasium
+    ``CartPole-v1`` through the repo's ``make_vector_env`` ``SyncVectorEnv``
+    wrapper stack, with random actions."""
+    from sheeprl_tpu.utils.env import make_vector_env
+
+    cfg = compose(
+        overrides=[
+            "exp=ppo",
+            "env=gym",
+            "env.id=CartPole-v1",
+            "algo.mlp_keys.encoder=[state]",
+            f"env.num_envs={num_envs}",
+            "env.capture_video=False",
+            "env.sync_env=True",
+            "buffer.memmap=False",
+        ]
+    )
+    return _time_vector_env(make_vector_env(cfg, seed, 0), num_envs, steps, seed)
+
+
+def bench_host_raw_gym(num_envs: int, steps: int, seed: int = 0) -> float:
+    """Env-steps/s of bare ``gym.make`` under ``SyncVectorEnv`` — no repo
+    wrappers, the host python loop's best case."""
+    envs = gym.vector.SyncVectorEnv([lambda: gym.make("CartPole-v1") for _ in range(num_envs)])
+    return _time_vector_env(envs, num_envs, steps, seed)
+
+
+def bench_anakin_env_steps(num_envs: int, steps: int, seed: int = 0) -> float:
+    """Env-steps/s of the vmapped pure-JAX CartPole auto-reset-stepping inside one
+    jitted scan, random actions drawn in-jit (no policy — the raw env ceiling).
+    Per-step keys/actions derive in ONE bulk threefry before the scan instead of
+    per-step ``split`` chains — same distribution, ~1.5x on CPU where the PRNG
+    hashing is a visible fraction of the tiny physics."""
+    env = make_jax_env("cartpole")
+    params = env.default_params()
+    vstep = jax.vmap(env.step_autoreset, in_axes=(None, 0, 0, 0))
+
+    @jax.jit
+    def rollout(env_state, key):
+        k_act, k_step = jax.random.split(key)
+        actions = jax.random.randint(k_act, (steps, num_envs), 0, 2, dtype=jnp.int32)
+        step_keys = jax.random.split(k_step, steps * num_envs).reshape(steps, num_envs, 2)
+
+        def step(env_state, x):
+            a, ks = x
+            env_state, _obs, reward, _done, _info = vstep(params, env_state, a, ks)
+            return env_state, reward
+
+        env_state, rewards = jax.lax.scan(step, env_state, (actions, step_keys))
+        return env_state, rewards.sum()
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), num_envs)
+    env_state, _ = jax.vmap(env.reset, in_axes=(None, 0))(params, keys)
+    env_state, total = rollout(env_state, jax.random.PRNGKey(seed + 1))  # warmup/compile
+    jax.device_get(total)
+    t0 = time.perf_counter()
+    env_state, total = rollout(env_state, jax.random.PRNGKey(seed + 2))
+    jax.device_get(total)
+    elapsed = time.perf_counter() - t0
+    return steps * num_envs / elapsed
+
+
+def bench_anakin_ppo(num_envs: int, rollout_steps: int, iters: int, seed: int = 0) -> Dict[str, float]:
+    """Grad-steps/s + env-steps/s of the full fused PPO Anakin iteration (the
+    program ``engine/anakin.py`` dispatches per update)."""
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+    from sheeprl_tpu.engine.anakin import init_episode_stats, make_ppo_anakin_iteration, reset_envs
+
+    cfg = compose(
+        overrides=[
+            "exp=ppo",
+            "env=jax_cartpole",
+            "algo.anakin=True",
+            "algo.mlp_keys.encoder=[state]",
+            f"env.num_envs={num_envs}",
+            f"algo.rollout_steps={rollout_steps}",
+            f"algo.per_rank_batch_size={max(rollout_steps * num_envs // 4, 1)}",
+            "algo.update_epochs=4",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+        ]
+    )
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=seed)
+    env = make_jax_env("cartpole")
+    env_params = env.default_params()
+    obs_space = gym.spaces.Dict({"state": env.observation_space(env_params)})
+    agent, params = build_agent(ctx, env.action_space(env_params), obs_space, cfg)
+    fns = PPOTrainFns(ctx, agent, cfg, ["state"], max(iters, 1))
+    opt_state = ctx.replicate(fns.opt.init(params))
+    iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, "state")
+    dispatch = jax.jit(iteration, donate_argnums=(0,))
+
+    env_state, obs0 = reset_envs(env, env_params, num_envs, jax.random.PRNGKey(seed))
+    carry = {
+        "params": params,
+        "opt_state": opt_state,
+        "env_state": env_state,
+        "obs": obs0,
+        "key": jax.random.PRNGKey(seed + 1),
+        "episode_stats": init_episode_stats(num_envs),
+    }
+    carry, metrics = dispatch(carry, 0.2, 0.0)  # warmup/compile
+    jax.device_get(metrics)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry, metrics = dispatch(carry, 0.2, 0.0)
+    jax.device_get(metrics)
+    elapsed = time.perf_counter() - t0
+    env_steps = iters * rollout_steps * num_envs
+    grad_steps = iters * fns.grad_steps_per_update
+    return {
+        "grad_steps_per_sec": grad_steps / elapsed,
+        "env_steps_per_sec": env_steps / elapsed,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> Dict[str, float]:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--num-envs", type=int, default=int(os.environ.get("BENCH_ANAKIN_ENVS", "1024")))
+    parser.add_argument("--steps", type=int, default=int(os.environ.get("BENCH_ANAKIN_STEPS", "2048")))
+    parser.add_argument("--host-steps", type=int, default=int(os.environ.get("BENCH_ANAKIN_HOST_STEPS", "512")))
+    parser.add_argument("--rollout-steps", type=int, default=128)
+    parser.add_argument("--ppo-envs", type=int, default=int(os.environ.get("BENCH_ANAKIN_PPO_ENVS", "64")))
+    parser.add_argument("--iters", type=int, default=int(os.environ.get("BENCH_ANAKIN_ITERS", "8")))
+    parser.add_argument(
+        "--host-envs",
+        type=int,
+        default=4,
+        help="env count for the 'current training config' host baseline (the env/default.yaml num_envs)",
+    )
+    args = parser.parse_args(argv)
+
+    host_sps = bench_host_sync_vector(args.host_envs, args.host_steps)
+    raw_envs = min(args.num_envs, 64)  # the python loop saturates long before 64
+    host_raw = bench_host_raw_gym(raw_envs, max(args.host_steps // 2, 16))
+    anakin_sps = bench_anakin_env_steps(args.num_envs, args.steps)
+    rows = [
+        {
+            "metric": "anakin_cartpole_steps_per_sec",
+            "value": round(anakin_sps, 1),
+            "unit": f"env_steps/s ({args.num_envs} vmapped jax CartPole in one jitted scan, 1 chip)",
+            "host_sync_vector_steps_per_sec": round(host_sps, 1),
+            "host_envs": args.host_envs,
+            "speedup_vs_host": round(anakin_sps / host_sps, 1),
+            "host_raw_gym_saturated_steps_per_sec": round(host_raw, 1),
+            "host_raw_gym_envs": raw_envs,
+            "speedup_vs_raw_gym_saturated": round(anakin_sps / host_raw, 1),
+        }
+    ]
+    ppo = bench_anakin_ppo(args.ppo_envs, args.rollout_steps, args.iters)
+    rows.append(
+        {
+            "metric": "anakin_ppo_grad_steps_per_sec",
+            "value": round(ppo["grad_steps_per_sec"], 1),
+            "unit": (
+                f"grad_steps/s (fused collect+GAE+update dispatch, {args.ppo_envs} envs x "
+                f"{args.rollout_steps} rollout, 1 chip)"
+            ),
+            "anakin_ppo_env_steps_per_sec": round(ppo["env_steps_per_sec"], 1),
+        }
+    )
+    for row in rows:
+        print(json.dumps(row))
+    return {row["metric"]: row["value"] for row in rows}
+
+
+if __name__ == "__main__":
+    main()

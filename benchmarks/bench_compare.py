@@ -30,6 +30,10 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 _LOWER_BETTER_HINTS = ("ms", "latency", "time", "seconds")
+# Explicit direction pins beat the unit-text heuristic: every anakin_* row
+# (benchmarks/anakin_bench.py) is a throughput — higher is better — regardless
+# of what its unit string mentions.
+_HIGHER_BETTER_PREFIXES = ("anakin_",)
 
 
 def extract_metrics(path: str) -> Dict[str, Tuple[float, str]]:
@@ -68,6 +72,8 @@ def extract_metrics(path: str) -> Dict[str, Tuple[float, str]]:
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
+    if str(metric).lower().startswith(_HIGHER_BETTER_PREFIXES):
+        return False
     blob = f"{metric} {unit}".lower()
     return any(hint in blob for hint in _LOWER_BETTER_HINTS)
 

@@ -27,7 +27,9 @@ def evaluate_ppo(ctx, cfg: Dict[str, Any], ckpt_path: str) -> float:
 
     agent, params = build_agent(ctx, act_space, obs_space, cfg)
     state = CheckpointManager.load(ckpt_path, templates={"params": jax.device_get(params)})
-    params = ctx.replicate(state["params"])
+    # Anakin runs (algo.anakin=True) checkpoint the whole scan carry; the policy
+    # params live inside it (engine/anakin.py).
+    params = ctx.replicate(state["carry"]["params"] if "params" not in state else state["params"])
     reward = test(agent, params, ctx, cfg, log_dir)
     print(f"Test/cumulative_reward: {reward}")
     return reward

@@ -72,11 +72,19 @@ def make_optimizer(opt_cfg: Dict[str, Any], max_grad_norm: float, lr_schedule=No
         opt = optax.sgd(lr, momentum=opt_cfg.get("momentum", 0.0))
     elif name == "rmsprop_tf":
         # TF-style RMSProp: eps inside the sqrt (reference optim/rmsprop_tf.py:14-156).
-        opt = optax.rmsprop(
-            lr, decay=opt_cfg.get("alpha", 0.99), eps=opt_cfg.get("eps", 1e-8),
+        # optax moved the eps placement behind an ``eps_in_sqrt`` kwarg whose default
+        # is deprecating (>=0.2.4); pin the TF behavior explicitly where the kwarg
+        # exists, and fall back cleanly on older optax whose rmsprop ALWAYS put the
+        # eps inside the sqrt — both paths compute the same update.
+        import inspect
+
+        rmsprop_kwargs = dict(
+            decay=opt_cfg.get("alpha", 0.99), eps=opt_cfg.get("eps", 1e-8),
             centered=opt_cfg.get("centered", False), momentum=opt_cfg.get("momentum", 0.0),
-            eps_in_sqrt=True,
         )
+        if "eps_in_sqrt" in inspect.signature(optax.rmsprop).parameters:
+            rmsprop_kwargs["eps_in_sqrt"] = True
+        opt = optax.rmsprop(lr, **rmsprop_kwargs)
     else:
         raise ValueError(f"Unknown optimizer: {name}")
     if max_grad_norm and max_grad_norm > 0:
@@ -190,6 +198,12 @@ class PPOTrainFns:
 
 @register_algorithm(name="ppo")
 def main(ctx, cfg) -> None:
+    if cfg.algo.anakin:
+        # Anakin mode (howto/anakin.md): on-device jax envs, acting and the SAME
+        # jitted update fused into one donated scan — the engine owns the loop.
+        from sheeprl_tpu.engine.anakin import ppo_anakin
+
+        return ppo_anakin(ctx, cfg)
     rank = ctx.process_index
     if cfg.algo.per_rank_batch_size <= 0:
         raise ValueError("algo.per_rank_batch_size must be positive")

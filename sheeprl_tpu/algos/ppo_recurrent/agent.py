@@ -195,16 +195,20 @@ class RecurrentPPOAgent(nn.Module):
             actor_out, values = self._heads(outs.astype(jnp.float32))
             return actor_out, values
 
-        def scan_step(carry, t):
+        def scan_step(mdl, carry, t):
+            # The body must touch submodules through the TRANSFORMED module
+            # ``mdl`` nn.scan hands it — reaching through the closed-over
+            # ``self`` mixes the outer module with the scan's inner trace, which
+            # newer flax rejects with JaxTransformError.
             c, h = carry
             x, first = t
             c = (1 - first) * c
             h = (1 - first) * h
-            (c, h), out = self.cell((c, h), x)
+            (c, h), out = mdl.cell((c, h), x)
             return (c, h), out
 
         _, outs = nn.scan(
-            lambda mdl, carry, t: scan_step(carry, t),
+            scan_step,
             variable_broadcast="params",
             split_rngs={"params": False},
         )(self, initial_state, (xs, is_first))

@@ -214,6 +214,12 @@ def make_sac_fused_builder(actor, critic, cfg, act_space, ring, batch_size: int)
 
 @register_algorithm(name="sac")
 def main(ctx, cfg) -> None:
+    if cfg.algo.anakin:
+        # Anakin mode (howto/anakin.md): jax envs + ring writes + the fused UTD
+        # update all inside one donated scan — the engine owns the loop.
+        from sheeprl_tpu.engine.anakin import sac_anakin
+
+        return sac_anakin(ctx, cfg)
     rank = ctx.process_index
     log_dir = get_log_dir(cfg)
     if ctx.is_global_zero:

@@ -352,6 +352,33 @@ class DeviceTransitionRing(DeviceReplayMirror):
             st[:, :rows, 0] = np.asarray(stamps[:rows], np.int64)
         self.arrays[STAMP_KEY] = self._device(st)
 
+    def make_scan_writer(self):
+        """Pure in-scan analogue of :meth:`add_step`, for loops that carry the ring
+        arrays THROUGH a fused scan instead of scattering from host (the Anakin
+        engine, ``sheeprl_tpu/engine/anakin.py``): ``write(arrays, rows,
+        rows_added) -> arrays`` writes one transition row for every env at the
+        (traced) slot ``rows_added % capacity`` and stamps the rows with
+        ``rows_added`` so ``Health/replay_age_*`` keep working off the same
+        :meth:`make_sample_gather`.  ``rows[k]`` is ``[n_envs, *row_shape]``;
+        ``rows_added`` is the cumulative added-row counter BEFORE the write."""
+        batch_keys = self._batch_keys
+        flat = self._flat
+        specs = self.specs
+        cap = self.capacity
+        n_envs = self.n_envs
+
+        def write(arrays, rows, rows_added):
+            pos = jnp.mod(jnp.asarray(rows_added, jnp.int32), cap)
+            out = dict(arrays)
+            for k in batch_keys:
+                row = rows[k].reshape(n_envs, flat[k]).astype(specs[k][1])
+                out[k] = arrays[k].at[:, pos].set(row)
+            stamp = jnp.full((n_envs, 1), 0, jnp.int32) + jnp.asarray(rows_added, jnp.int32)
+            out[STAMP_KEY] = arrays[STAMP_KEY].at[:, pos].set(stamp)
+            return out
+
+        return write
+
     def sample_indices(self, filled, key, batch_size: int):
         """The exact in-jit uniform index draw the fused train blocks run: ``[B]``
         (env, row) int32 pairs, rows uniform over ``[0, filled)`` and envs uniform

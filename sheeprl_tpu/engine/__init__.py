@@ -1,0 +1,7 @@
+"""Shared acting/update engine (ROADMAP item 1): the Anakin training mode fuses
+vmapped on-device envs, acting, replay-ring writes and the gradient update into
+one donated jitted ``lax.scan`` dispatch — see :mod:`sheeprl_tpu.engine.anakin`."""
+
+from sheeprl_tpu.engine.anakin import anakin_enabled, ppo_anakin, sac_anakin
+
+__all__ = ["anakin_enabled", "ppo_anakin", "sac_anakin"]

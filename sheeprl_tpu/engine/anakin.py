@@ -1,0 +1,824 @@
+"""Anakin training mode: acting + env stepping + update fused into ONE dispatch.
+
+PROFILE_r05 §1 measured the two remaining end-to-end walls as architectural:
+~125 ms/iteration of player round trip (the host fetches one action from the
+policy jit per env step) and ~150 ms of single-core host env stepping.  The
+Podracer "Anakin" architecture (arxiv 2104.06272) removes both: the environment
+itself is a pure JAX function (``sheeprl_tpu/envs/jax``), N instances vmap into
+one tensor program, and env step, acting, transition writes and the gradient
+update compile into a single donated jitted ``lax.scan`` — zero player RTT,
+zero host env stepping, zero H2D per step.  The host's entire per-dispatch job
+is one jit call plus counter bookkeeping.
+
+This module is the shared acting/update engine ROADMAP item 1 names: the PPO
+and SAC entry points delegate here when ``algo.anakin=True`` (requires a
+``env.jax.enabled`` env), reusing their existing jitted update builders —
+
+* PPO: the fused iteration collects a ``rollout_steps`` on-device rollout and
+  then calls the UNCHANGED :class:`~sheeprl_tpu.algos.ppo.ppo.PPOTrainFns`
+  ``train_fn`` on it, so the Anakin update is bit-identical to the host path
+  given the same collected batch (pinned by ``tests/test_algos/test_anakin.py``);
+* SAC (and DroQ via the same ``make_sac_step_fn``): each in-scan iteration steps
+  the envs once, writes the transitions into the PR-5
+  :class:`~sheeprl_tpu.data.device_buffer.DeviceTransitionRing` layout carried
+  through the scan (``make_scan_writer``), and runs ``replay_ratio`` gradient
+  steps off the ring with in-jit uniform sampling (``make_sample_gather``).
+
+Metrics (``Rewards/rew_avg``, episode lengths, ``Loss/*``, ``Health/*``) are
+accumulated inside the scan carry, returned per dispatch as device futures and
+drained at the existing log cadence — zero extra host syncs per step.  The
+scan carry (env states, ring + counters, PRNG key, params, optimizer state)
+round-trips through :class:`~sheeprl_tpu.checkpoint.manager.CheckpointManager`
+for mid-run resume, and the flight recorder stages a device-side copy of the
+carry post-dispatch (the dispatch DONATES it) exactly like the PR-5 fused ring
+blocks.  See ``howto/anakin.md``.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite, nan_scan, strict_enabled, strict_guard
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.config.core import save_config
+from sheeprl_tpu.envs.jax import make_jax_env
+from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
+from sheeprl_tpu.obs.health import health_enabled
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, polynomial_decay
+
+EPISODE_SUM_KEYS = ("Episodes/return_sum", "Episodes/len_sum", "Episodes/count")
+
+
+def anakin_enabled(cfg) -> bool:
+    """The mode gate the entry points test before falling back to their host loop."""
+    return bool(cfg.algo.get("anakin", False))
+
+
+def anakin_env(cfg):
+    """Build the pure-functional env + params from the config; hard errors beat a
+    silent host fallback — the user asked for the fused mode explicitly."""
+    if not bool(cfg.env.jax.get("enabled", False)):
+        raise ValueError(
+            "algo.anakin=True needs an on-device JAX environment: pick one with "
+            "env=jax_cartpole / jax_pendulum / jax_mountain_car (or set "
+            "env.jax.enabled=True with env.jax.env_id for a gymnax env)."
+        )
+    if jax.process_count() > 1:
+        raise ValueError(
+            "algo.anakin=True is single-process (the fused scan owns the whole "
+            "env+learner state); use the host loops for multi-host runs."
+        )
+    env = make_jax_env(cfg.env.jax.env_id or cfg.env.id)
+    return env, env.default_params()
+
+
+def anakin_mlp_key(cfg) -> str:
+    """Anakin envs expose ONE flat vector observation; map it to the single
+    configured MLP key (the agents' obs-dict contract)."""
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    if cnn_keys or len(mlp_keys) != 1:
+        raise ValueError(
+            "algo.anakin=True supports exactly one MLP observation key and no CNN "
+            f"keys (the jax envs are flat-vector); got cnn={cnn_keys} mlp={mlp_keys}."
+        )
+    return mlp_keys[0]
+
+
+# --------------------------------------------------------------------- episodes
+def init_episode_stats(num_envs: int) -> Dict[str, jax.Array]:
+    """Per-env running episode accumulators + the dispatch-window sums, all carried
+    through the scan (drained at the log cadence, never per step)."""
+    return {
+        "ep_return": jnp.zeros((num_envs,), jnp.float32),
+        "ep_len": jnp.zeros((num_envs,), jnp.int32),
+        "return_sum": jnp.zeros((), jnp.float32),
+        "len_sum": jnp.zeros((), jnp.float32),
+        "count": jnp.zeros((), jnp.float32),
+    }
+
+
+def reset_episode_sums(stats: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    return {
+        **stats,
+        "return_sum": jnp.zeros((), jnp.float32),
+        "len_sum": jnp.zeros((), jnp.float32),
+        "count": jnp.zeros((), jnp.float32),
+    }
+
+
+def update_episode_stats(stats: Dict[str, jax.Array], reward: jax.Array, done: jax.Array):
+    """One vectorized env step's bookkeeping: accumulate running returns/lengths,
+    fold finished episodes into the window sums, reset the finished envs."""
+    ep_return = stats["ep_return"] + reward
+    ep_len = stats["ep_len"] + 1
+    d = done.astype(jnp.float32)
+    return {
+        "ep_return": ep_return * (1.0 - d),
+        "ep_len": ep_len * (1 - done.astype(jnp.int32)),
+        "return_sum": stats["return_sum"] + jnp.sum(ep_return * d),
+        "len_sum": stats["len_sum"] + jnp.sum(ep_len.astype(jnp.float32) * d),
+        "count": stats["count"] + jnp.sum(d),
+    }
+
+
+def episode_metrics(stats: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    return {
+        "Episodes/return_sum": stats["return_sum"],
+        "Episodes/len_sum": stats["len_sum"],
+        "Episodes/count": stats["count"],
+    }
+
+
+class AnakinFutures:
+    """Deferred per-dispatch metric futures (the Anakin cousin of
+    ``utils.blocks.WindowedFutures``): ``track`` keeps the dispatch's metrics tree
+    ON DEVICE, ``drain`` is the window's only blocking fetch — episode sums are
+    folded into ``Rewards/rew_avg``/``Game/ep_len_avg`` and every other key feeds
+    the aggregator.  Window wall-clock gives honest env-steps/s + grad-steps/s."""
+
+    def __init__(self):
+        self._pending = []
+        self._window_env_steps = 0
+        self._window_grad_steps = 0
+        self._window_t0 = 0.0
+
+    def track(self, metrics: Any, env_steps: int, grad_steps: int) -> None:
+        if not self._pending and self._window_env_steps == 0:
+            self._window_t0 = time.perf_counter()
+        self._pending.append(metrics)
+        self._window_env_steps += env_steps
+        self._window_grad_steps += grad_steps
+
+    def drain(self, aggregator: Optional[MetricAggregator]) -> Dict[str, float]:
+        """Fetch every pending dispatch's metrics (one blocking device_get), feed
+        the aggregator and return the window's derived rates/episode means."""
+        fetched = jax.device_get(self._pending) if self._pending else []
+        self._pending.clear()
+        ret_sum = len_sum = count = 0.0
+        for tree in fetched:
+            ret_sum += float(tree.pop("Episodes/return_sum", 0.0))
+            len_sum += float(tree.pop("Episodes/len_sum", 0.0))
+            count += float(tree.pop("Episodes/count", 0.0))
+            if aggregator is not None:
+                for k, v in tree.items():
+                    aggregator.update(k, float(v))
+        elapsed = max(time.perf_counter() - self._window_t0, 1e-9)
+        out: Dict[str, float] = {}
+        if count > 0 and aggregator is not None:
+            aggregator.update("Rewards/rew_avg", ret_sum / count)
+            aggregator.update("Game/ep_len_avg", len_sum / count)
+        if self._window_env_steps > 0:
+            out["Time/sps_env_interaction"] = self._window_env_steps / elapsed
+        if self._window_grad_steps > 0:
+            out["Time/sps_train"] = self._window_grad_steps / elapsed
+        self._window_env_steps = 0
+        self._window_grad_steps = 0
+        return out
+
+
+def reset_envs(env, env_params, num_envs: int, key: jax.Array):
+    keys = jax.random.split(key, num_envs)
+    return jax.vmap(env.reset, in_axes=(None, 0))(env_params, keys)
+
+
+def stage_carry(recorder, carry, **scalars) -> None:
+    """Post-dispatch flight-recorder staging: the dispatch DONATED the carry, so
+    pre-step references are gone — stage a device-side copy (async, no host sync)
+    of the state entering the NEXT dispatch, as the PR-5 fused ring blocks do."""
+    if recorder is not None:
+        recorder.stage_step(carry=jax.tree.map(jnp.copy, carry), scalars=scalars)
+
+
+# -------------------------------------------------------------------------- PPO
+def make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key: str, return_batch: bool = False):
+    """One fused PPO training iteration: an on-device ``rollout_steps`` collection
+    scan (vmapped env + acting policy), GAE, then the UNCHANGED
+    ``PPOTrainFns.train_fn`` — calling the already-jitted update inlines the same
+    program, which is what makes the Anakin update bit-identical to the host path
+    on the same batch.  ``return_batch=True`` (tests/bench) also returns the
+    collected batch + the exact key fed to ``train_fn``."""
+    from sheeprl_tpu.algos.ppo.utils import sample_actions
+
+    num_envs = int(cfg.env.num_envs)
+    rollout_steps = int(cfg.algo.rollout_steps)
+    batch_n = rollout_steps * num_envs
+    gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
+    clip_rewards = bool(cfg.env.clip_rewards)
+    is_continuous = agent.is_continuous
+    discrete_scalar = not is_continuous and len(agent.action_dims) == 1
+    act_space = env.action_space(env_params)
+    clip_act = is_continuous and bool(
+        np.isfinite(act_space.low).all() and np.isfinite(act_space.high).all()
+    )
+    act_low = jnp.asarray(getattr(act_space, "low", 0.0), jnp.float32)
+    act_high = jnp.asarray(getattr(act_space, "high", 0.0), jnp.float32)
+    vstep = jax.vmap(env.step_autoreset, in_axes=(None, 0, 0, 0))
+
+    def iteration(carry, clip_coef, ent_coef):
+        params = carry["params"]
+        stats0 = reset_episode_sums(carry["episode_stats"])
+
+        def act_step(c, _):
+            env_state, obs, key, stats = c
+            key, k_act, k_step = jax.random.split(key, 3)
+            actor_out, value = agent.apply(params, {obs_key: obs})
+            env_act, stored_act, logprob = sample_actions(k_act, actor_out, is_continuous)
+            if clip_act:
+                env_actions = jnp.clip(env_act, act_low, act_high)
+            elif discrete_scalar:
+                env_actions = env_act[..., 0].astype(jnp.int32)
+            else:
+                env_actions = env_act
+            step_keys = jax.random.split(k_step, num_envs)
+            env_state, next_obs, reward, done, _info = vstep(env_params, env_state, env_actions, step_keys)
+            if clip_rewards:
+                reward = jnp.clip(reward, -1, 1)
+            stats = update_episode_stats(stats, reward, done)
+            ys = {
+                obs_key: obs,
+                "actions": stored_act.reshape(num_envs, -1).astype(jnp.float32),
+                "logprobs": logprob.reshape(num_envs),
+                "values": value[..., 0],
+                "rewards": reward.astype(jnp.float32),
+                "dones": done.astype(jnp.float32),
+            }
+            return (env_state, next_obs, key, stats), ys
+
+        (env_state, obs, key, stats), traj = jax.lax.scan(
+            act_step, (carry["env_state"], carry["obs"], carry["key"], stats0), None, length=rollout_steps
+        )
+        _, next_value = agent.apply(params, {obs_key: obs})
+        returns, advantages = gae(
+            traj["rewards"][..., None],
+            traj["values"][..., None],
+            traj["dones"][..., None],
+            next_value[..., 0:1],
+            rollout_steps,
+            gamma,
+            gae_lambda,
+        )
+        data = {
+            obs_key: traj[obs_key],
+            "actions": traj["actions"],
+            "logprobs": traj["logprobs"],
+            "values": traj["values"],
+            "returns": returns[..., 0],
+            "advantages": advantages[..., 0],
+        }
+        data = jax.tree.map(lambda x: x.reshape(batch_n, *x.shape[2:]), data)
+
+        key, k_train = jax.random.split(key)
+        params, opt_state, metrics = fns.train_fn(
+            params, carry["opt_state"], data, k_train, clip_coef, ent_coef
+        )
+        metrics = {**metrics, **episode_metrics(stats)}
+        new_carry = {
+            "params": params,
+            "opt_state": opt_state,
+            "env_state": env_state,
+            "obs": obs,
+            "key": key,
+            "episode_stats": stats,
+        }
+        if return_batch:
+            return new_carry, metrics, data, k_train
+        return new_carry, metrics
+
+    return iteration
+
+
+def ppo_anakin(ctx, cfg) -> None:
+    """The Anakin PPO entry path (``algo.anakin=True``), called by
+    ``sheeprl_tpu.algos.ppo.ppo.main``."""
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+    from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, test
+
+    env, env_params = anakin_env(cfg)
+    obs_key = anakin_mlp_key(cfg)
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
+
+    obs_space = gym.spaces.Dict({obs_key: env.observation_space(env_params)})
+    act_space = env.action_space(env_params)
+    agent, params = build_agent(ctx, act_space, obs_space, cfg)
+
+    num_envs = int(cfg.env.num_envs)
+    rollout_steps = int(cfg.algo.rollout_steps)
+    policy_steps_per_iter = num_envs * rollout_steps
+    total_steps = int(cfg.algo.total_steps)
+    num_updates = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
+
+    fns = PPOTrainFns(ctx, agent, cfg, [obs_key], num_updates)
+    opt_state = ctx.replicate(fns.opt.init(params))
+    iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key)
+    # The whole iteration is ONE donated jit: env scan + GAE + the update block.
+    dispatch = strict_guard(cfg, "anakin/ppo_dispatch", jax.jit(iteration, donate_argnums=(0,)))
+
+    env_state, obs0 = reset_envs(env, env_params, num_envs, ctx.local_rng())
+    carry = {
+        "params": params,
+        "opt_state": opt_state,
+        "env_state": env_state,
+        "obs": obs0,
+        "key": ctx.rng(),
+        "episode_stats": init_episode_stats(num_envs),
+    }
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    futures = AnakinFutures()
+    recorder = flight_recorder.get_active()
+    if recorder is not None:
+        recorder.arm_replay("sheeprl_tpu.engine.anakin:replay_update", num_updates=num_updates)
+
+    start_update, policy_step, last_log, last_checkpoint = 1, 0, 0, 0
+    if cfg.checkpoint.get("resume_from"):
+        state = CheckpointManager.load(
+            cfg.checkpoint.resume_from, templates={"carry": jax.device_get(carry)}
+        )
+        carry = ctx.replicate(state["carry"])
+        start_update = state["update"] + 1
+        policy_step = state["policy_step"]
+        last_log = state.get("last_log", 0)
+        last_checkpoint = state.get("last_checkpoint", 0)
+
+    grad_steps_per_update = fns.grad_steps_per_update
+    for update in range(start_update, num_updates + 1):
+        monitor.advance()
+        clip_coef, ent_coef = cfg.algo.clip_coef, cfg.algo.ent_coef
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(update, initial=clip_coef, final=0.0, max_decay_steps=num_updates)
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(update, initial=ent_coef, final=0.0, max_decay_steps=num_updates)
+        with timer("Time/train_time"), monitor.phase("dispatch"):
+            carry, metrics = dispatch(carry, float(clip_coef), float(ent_coef))
+        futures.track(metrics, policy_steps_per_iter, grad_steps_per_update)
+        policy_step += policy_steps_per_iter
+        stage_carry(recorder, carry, update=update, clip_coef=float(clip_coef), ent_coef=float(ent_coef))
+
+        if logger is not None and (
+            policy_step - last_log >= cfg.metric.log_every or update == num_updates or cfg.dry_run
+        ):
+            out = futures.drain(aggregator)  # the window's only blocking device sync
+            out.update(aggregator.compute())
+            out["Params/lr"] = (
+                float(fns.lr_schedule(update * grad_steps_per_update))
+                if fns.lr_schedule is not None
+                else float(cfg.algo.optimizer.lr)
+            )
+            monitor.log_metrics(logger, out, policy_step)
+            aggregator.reset()
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or update == num_updates
+            and cfg.checkpoint.save_last
+        ):
+            with monitor.phase("checkpoint"):
+                ckpt_manager.save(
+                    policy_step,
+                    {
+                        "carry": carry,
+                        "update": update,
+                        "policy_step": policy_step,
+                        "last_log": last_log,
+                        "last_checkpoint": policy_step,
+                    },
+                )
+            last_checkpoint = policy_step
+
+    monitor.close()
+    if cfg.algo.run_test and ctx.is_global_zero:
+        reward = test(agent, carry["params"], ctx, cfg, log_dir)
+        if logger is not None:
+            logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if logger is not None:
+        logger.close()
+
+
+# -------------------------------------------------------------------------- SAC
+def make_sac_anakin_dispatch(env, env_params, actor, critic, cfg, act_space, ring, batch_size: int):
+    """Builder of fused SAC Anakin dispatch programs: ``builder(steps,
+    grad_per_step, train)`` returns the python function for a ``steps``-iteration
+    scan where each iteration steps the vmapped envs once, writes the transition
+    row into the ring arrays CARRIED through the scan
+    (:meth:`DeviceTransitionRing.make_scan_writer`), and — when ``train`` — runs
+    ``grad_per_step`` :func:`~sheeprl_tpu.algos.sac.sac.make_sac_step_fn` updates
+    off in-jit uniform ring sampling.  ``train=False`` is the prefill program
+    (uniform random actions, no updates).  DroQ rides the same shape through its
+    own step fn."""
+    from sheeprl_tpu.algos.sac.sac import make_sac_step_fn
+
+    actor_opt, critic_opt, alpha_opt, step_update = make_sac_step_fn(actor, critic, cfg, act_space)
+    sample_gather = ring.make_sample_gather(batch_size)
+    write_row = ring.make_scan_writer()
+    num_envs = ring.n_envs
+    cap = ring.capacity
+    strict = strict_enabled(cfg)
+    health = health_enabled(cfg)
+    clip_rewards = bool(cfg.env.clip_rewards)
+    act_low = jnp.asarray(act_space.low, jnp.float32)
+    act_high = jnp.asarray(act_space.high, jnp.float32)
+    rescale = bool(np.isfinite(act_space.low).all() and np.isfinite(act_space.high).all())
+    vstep = jax.vmap(env.step_autoreset, in_axes=(None, 0, 0, 0))
+    vsample = jax.vmap(env.sample_action, in_axes=(None, 0))
+
+    def builder(steps: int, grad_per_step: int, train: bool):
+        def dispatch(carry):
+            def iter_step(c, _):
+                params, o_state, env_state, obs, arrays, rows_added, gstep, key, stats = c
+                key, k_act, k_step = jax.random.split(key, 3)
+                if train:  # trace-time constant: prefill compiles its own program
+                    mean, log_std = actor.apply(params["actor"], obs)
+                    tanh_act = actor.dist(mean, log_std).sample(k_act)
+                else:
+                    raw = vsample(env_params, jax.random.split(k_act, num_envs))
+                    tanh_act = 2 * (raw - act_low) / (act_high - act_low) - 1 if rescale else raw
+                env_act = act_low + (tanh_act + 1) * 0.5 * (act_high - act_low) if rescale else tanh_act
+                step_keys = jax.random.split(k_step, num_envs)
+                env_state, next_obs, reward, done, info = vstep(env_params, env_state, env_act, step_keys)
+                if clip_rewards:
+                    reward = jnp.clip(reward, -1, 1)
+                stats = update_episode_stats(stats, reward, done)
+                rows = {
+                    "obs": obs,
+                    # the TRUE final obs of finishing episodes (autoreset already
+                    # swapped ``next_obs``), mirroring the host loops' final_obs fixup
+                    "next_obs": info["final_obs"],
+                    "actions": tanh_act,
+                    "rewards": reward[:, None].astype(jnp.float32),
+                    # truncated episodes still bootstrap (done=0 in the TD target)
+                    "dones": info["terminated"][:, None].astype(jnp.float32),
+                }
+                arrays = write_row(arrays, rows, rows_added)
+                rows_added = rows_added + 1
+                metrics = {}
+                if train and grad_per_step > 0:
+                    filled = jnp.minimum(rows_added, cap)
+
+                    def gstep_fn(cc, x):
+                        p, o = cc
+                        count, k = x
+                        k_sample, k_update = jax.random.split(k)
+                        batch, age_metrics = sample_gather(arrays, filled, rows_added, k_sample)
+                        p, o, m = step_update(p, o, count, batch, k_update)
+                        if health:  # replay staleness rides the same metrics tree
+                            m = {**m, **age_metrics}
+                        return (p, o), m
+
+                    key, k_grad = jax.random.split(key)
+                    counts = gstep + jnp.arange(grad_per_step, dtype=jnp.int32)
+                    gkeys = jax.random.split(k_grad, grad_per_step)
+                    (params, o_state), metrics = jax.lax.scan(
+                        gstep_fn, (params, o_state), (counts, gkeys)
+                    )
+                    metrics = jax.tree.map(jnp.mean, metrics)
+                    gstep = gstep + grad_per_step
+                return (params, o_state, env_state, next_obs, arrays, rows_added, gstep, key, stats), metrics
+
+            stats0 = reset_episode_sums(carry["episode_stats"])
+            init = (
+                carry["params"],
+                carry["opt_state"],
+                carry["env_state"],
+                carry["obs"],
+                carry["ring"],
+                carry["rows_added"],
+                carry["gstep"],
+                carry["key"],
+                stats0,
+            )
+            (params, o_state, env_state, obs, arrays, rows_added, gstep, key, stats), metrics = jax.lax.scan(
+                iter_step, init, None, length=steps
+            )
+            metrics = jax.tree.map(jnp.mean, metrics)
+            metrics = {**metrics, **episode_metrics(stats)}
+            metrics = maybe_inject_nonfinite(cfg, metrics)
+            if strict:  # trace-time constant: the callback only exists in strict runs
+                nan_scan(metrics, "anakin/sac_dispatch")
+            new_carry = {
+                "params": params,
+                "opt_state": o_state,
+                "env_state": env_state,
+                "obs": obs,
+                "ring": arrays,
+                "rows_added": rows_added,
+                "gstep": gstep,
+                "key": key,
+                "episode_stats": stats,
+            }
+            return new_carry, metrics
+
+        return dispatch
+
+    return actor_opt, critic_opt, alpha_opt, builder
+
+
+class SacAnakinDispatcher:
+    """Compile-once cache of the SAC dispatch programs keyed on (steps,
+    grad_per_step, train) — the steady state uses exactly one program; the
+    prefill and a tail remainder add at most two more."""
+
+    def __init__(self, builder, cfg):
+        self._builder = builder
+        self._cfg = cfg
+        self._programs: dict = {}
+
+    def __call__(self, carry, steps: int, grad_per_step: int, train: bool):
+        sig = (steps, grad_per_step, train)
+        prog = self._programs.get(sig)
+        if prog is None:
+            prog = strict_guard(
+                self._cfg,
+                f"anakin/sac_dispatch_{steps}x{grad_per_step}{'t' if train else 'p'}",
+                jax.jit(self._builder(steps, grad_per_step, train), donate_argnums=(0,)),
+            )
+            self._programs[sig] = prog
+        return prog(carry)
+
+
+def sac_anakin(ctx, cfg) -> None:
+    """The Anakin SAC entry path (``algo.anakin=True``), called by
+    ``sheeprl_tpu.algos.sac.sac.main``."""
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, test
+    from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+
+    env, env_params = anakin_env(cfg)
+    mlp_key = anakin_mlp_key(cfg)
+    replay_ratio = float(cfg.algo.replay_ratio)
+    grad_per_step = int(round(replay_ratio))
+    if grad_per_step < 1 or abs(replay_ratio - grad_per_step) > 1e-9:
+        raise ValueError(
+            f"algo.anakin=True needs an integer algo.replay_ratio >= 1 (the fused "
+            f"scan runs a static number of gradient steps per env step); got "
+            f"{replay_ratio}."
+        )
+
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
+
+    obs_space_box = env.observation_space(env_params)
+    act_space = env.action_space(env_params)
+    if not isinstance(act_space, gym.spaces.Box):
+        raise ValueError("SAC anakin needs a continuous (Box) jax env, e.g. env=jax_pendulum")
+    obs_space = gym.spaces.Dict({mlp_key: obs_space_box})
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    # Donation safety: critic_target aliases critic's buffers at init — a donated
+    # carry must not contain the same buffer twice (see the host ring path).
+    params = jax.tree.map(jnp.copy, params)
+
+    num_envs = int(cfg.env.num_envs)
+    obs_dim = int(np.prod(obs_space_box.shape))
+    act_dim = int(np.prod(act_space.shape))
+    batch_size = int(cfg.algo.per_rank_batch_size)
+    capacity = max(int(cfg.buffer.size) // max(num_envs, 1), 1)
+    ring = DeviceTransitionRing(
+        capacity,
+        num_envs,
+        {
+            "obs": ((obs_dim,), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+    actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
+        env, env_params, actor, critic, cfg, act_space, ring, batch_size
+    )
+    opt_state = ctx.replicate(
+        {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        }
+    )
+    dispatcher = SacAnakinDispatcher(builder, cfg)
+
+    env_state, obs0 = reset_envs(env, env_params, num_envs, ctx.local_rng())
+    carry = {
+        "params": params,
+        "opt_state": opt_state,
+        "env_state": env_state,
+        "obs": obs0,
+        "ring": ring.arrays,
+        "rows_added": jnp.zeros((), jnp.int32),
+        "gstep": jnp.zeros((), jnp.int32),
+        "key": ctx.rng(),
+        "episode_stats": init_episode_stats(num_envs),
+    }
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    futures = AnakinFutures()
+    recorder = flight_recorder.get_active()
+    if recorder is not None:
+        recorder.arm_replay("sheeprl_tpu.engine.anakin:replay_update")
+
+    total_steps = int(cfg.algo.total_steps)
+    num_iters = max(total_steps // max(num_envs, 1), 1) if not cfg.dry_run else 1
+    prefill_steps = int(cfg.algo.learning_starts) // max(num_envs, 1) if not cfg.dry_run else 0
+    prefill_steps = min(prefill_steps, num_iters - 1) if num_iters > 1 else 0
+    steps_per_dispatch = max(int(cfg.algo.anakin_steps_per_dispatch), 1) if not cfg.dry_run else 1
+
+    iter_num, policy_step, last_log, last_checkpoint = 0, 0, 0, 0
+    resumed = False
+    if cfg.checkpoint.get("resume_from"):
+        ckpt_carry = carry if cfg.buffer.checkpoint else {k: v for k, v in carry.items() if k != "ring"}
+        state = CheckpointManager.load(
+            cfg.checkpoint.resume_from, templates={"carry": jax.device_get(ckpt_carry)}
+        )
+        restored = ctx.replicate(state["carry"])
+        if "ring" not in restored:
+            # buffer.checkpoint=False dropped the ring: restart replay from empty
+            # (rows_added derives the in-jit sampling range, so it resets too).
+            restored = {**restored, "ring": carry["ring"], "rows_added": carry["rows_added"]}
+        carry = restored
+        iter_num = state["iter_num"]
+        policy_step = state["policy_step"]
+        last_log = state.get("last_log", 0)
+        last_checkpoint = state.get("last_checkpoint", 0)
+        resumed = True
+
+    def _maybe_log(final: bool) -> None:
+        nonlocal last_log
+        if logger is not None and (
+            policy_step - last_log >= cfg.metric.log_every or final or cfg.dry_run
+        ):
+            out = futures.drain(aggregator)  # the window's only blocking device sync
+            out.update(aggregator.compute())
+            if policy_step > 0:
+                out["Params/replay_ratio"] = grad_per_step  # static by construction
+            monitor.log_metrics(logger, out, policy_step)
+            aggregator.reset()
+            last_log = policy_step
+
+    def _maybe_checkpoint(final: bool) -> None:
+        nonlocal last_checkpoint
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or final
+            and cfg.checkpoint.save_last
+        ):
+            ckpt_carry = carry if cfg.buffer.checkpoint else {k: v for k, v in carry.items() if k != "ring"}
+            with monitor.phase("checkpoint"):
+                ckpt_manager.save(
+                    policy_step,
+                    {
+                        "carry": ckpt_carry,
+                        "iter_num": iter_num,
+                        "policy_step": policy_step,
+                        "last_log": last_log,
+                        "last_checkpoint": policy_step,
+                    },
+                )
+            last_checkpoint = policy_step
+
+    # Prefill: one dispatch of uniform random acting (a resumed run already has a
+    # trained policy and a restored ring — skip it, like the host loops).
+    if prefill_steps > 0 and iter_num < prefill_steps and not resumed:
+        monitor.advance()
+        with timer("Time/env_interaction_time"), monitor.phase("dispatch"):
+            carry, metrics = dispatcher(carry, prefill_steps - iter_num, 0, False)
+        futures.track(metrics, (prefill_steps - iter_num) * num_envs, 0)
+        policy_step += (prefill_steps - iter_num) * num_envs
+        iter_num = prefill_steps
+        stage_carry(recorder, carry, iter_num=iter_num)
+
+    while iter_num < num_iters:
+        monitor.advance()
+        steps = min(steps_per_dispatch, num_iters - iter_num)
+        with timer("Time/train_time"), monitor.phase("dispatch"):
+            carry, metrics = dispatcher(carry, steps, grad_per_step, True)
+        futures.track(metrics, steps * num_envs, steps * grad_per_step)
+        policy_step += steps * num_envs
+        iter_num += steps
+        stage_carry(recorder, carry, iter_num=iter_num)
+        final = iter_num >= num_iters
+        _maybe_log(final)
+        _maybe_checkpoint(final)
+
+    monitor.close()
+    if cfg.algo.run_test and ctx.is_global_zero:
+        reward = test(actor, carry["params"], ctx, cfg, log_dir)
+        if logger is not None:
+            logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
+    if logger is not None:
+        logger.close()
+
+
+# ------------------------------------------------------------------ replay
+def replay_update(cfg, dump_dir):
+    """Flight-recorder replay builder: an Anakin blackbox stages the carry
+    entering the NEXT dispatch (post-dispatch device-side copy — the dispatch
+    donates its input), so replay rebuilds the fused program from the dumped
+    config and re-executes that one dispatch on CPU."""
+    from sheeprl_tpu.obs import replay_blackbox
+    from sheeprl_tpu.parallel.mesh import make_mesh_context
+
+    ctx = make_mesh_context(cfg)
+    env, env_params = anakin_env(cfg)
+    obs_key = anakin_mlp_key(cfg)
+    obs_space = gym.spaces.Dict({obs_key: env.observation_space(env_params)})
+    act_space = env.action_space(env_params)
+    num_envs = int(cfg.env.num_envs)
+    env_state0, obs0 = reset_envs(env, env_params, num_envs, jax.random.PRNGKey(0))
+
+    algo_name = str(cfg.algo.name)
+    if algo_name.startswith("ppo"):
+        from sheeprl_tpu.algos.ppo.agent import build_agent
+        from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+
+        agent, params0 = build_agent(ctx, act_space, obs_space, cfg)
+        raw = replay_blackbox.load_state(dump_dir)
+        num_updates = int(raw["statics"].get("num_updates", 1))
+        fns = PPOTrainFns(ctx, agent, cfg, [obs_key], num_updates)
+        template = {
+            "params": params0,
+            "opt_state": fns.opt.init(params0),
+            "env_state": env_state0,
+            "obs": obs0,
+            "key": jax.random.PRNGKey(0),
+            "episode_stats": init_episode_stats(num_envs),
+        }
+        state = replay_blackbox.load_state(dump_dir, {"carry": jax.device_get(template)})
+        iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key)
+        scalars = state.get("scalars", {})
+        carry, metrics = jax.jit(iteration)(
+            ctx.replicate(state["carry"]),
+            float(scalars.get("clip_coef", cfg.algo.clip_coef)),
+            float(scalars.get("ent_coef", cfg.algo.ent_coef)),
+        )
+    else:
+        from sheeprl_tpu.algos.sac.agent import build_agent
+        from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+
+        actor, critic, params0 = build_agent(ctx, act_space, obs_space, cfg)
+        obs_dim = int(np.prod(obs_space[obs_key].shape))
+        act_dim = int(np.prod(act_space.shape))
+        capacity = max(int(cfg.buffer.size) // max(num_envs, 1), 1)
+        ring = DeviceTransitionRing(
+            capacity,
+            num_envs,
+            {
+                "obs": ((obs_dim,), jnp.float32),
+                "next_obs": ((obs_dim,), jnp.float32),
+                "actions": ((act_dim,), jnp.float32),
+                "rewards": ((1,), jnp.float32),
+                "dones": ((1,), jnp.float32),
+            },
+        )
+        actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
+            env, env_params, actor, critic, cfg, act_space, ring, int(cfg.algo.per_rank_batch_size)
+        )
+        template = {
+            "params": params0,
+            "opt_state": {
+                "actor": actor_opt.init(params0["actor"]),
+                "critic": critic_opt.init(params0["critic"]),
+                "alpha": alpha_opt.init(params0["log_alpha"]),
+            },
+            "env_state": env_state0,
+            "obs": obs0,
+            "ring": ring.arrays,
+            "rows_added": jnp.zeros((), jnp.int32),
+            "gstep": jnp.zeros((), jnp.int32),
+            "key": jax.random.PRNGKey(0),
+            "episode_stats": init_episode_stats(num_envs),
+        }
+        state = replay_blackbox.load_state(dump_dir, {"carry": jax.device_get(template)})
+        grad_per_step = int(round(float(cfg.algo.replay_ratio)))
+        dispatch = jax.jit(builder(1, grad_per_step, True))
+        carry, metrics = dispatch(ctx.replicate(state["carry"]))
+
+    host_metrics = jax.device_get(metrics)
+    import optax
+
+    return {
+        "metrics": host_metrics,
+        "new_param_norm": float(jax.device_get(optax.global_norm(carry["params"]))),
+    }

@@ -1,0 +1,47 @@
+"""Pure-functional JAX environments for the Anakin training mode
+(``sheeprl_tpu/engine/anakin.py``; Podracer, arxiv 2104.06272).
+
+``make_jax_env`` is the registry: in-tree classic-control ids (with or without
+the config-facing ``jax_`` prefix) plus ``gymnax:<EnvName>`` for any env from
+the optional gymnax package.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.envs.jax.cartpole import CartPole
+from sheeprl_tpu.envs.jax.core import JaxEnv
+from sheeprl_tpu.envs.jax.mountain_car import MountainCarContinuous
+from sheeprl_tpu.envs.jax.pendulum import Pendulum
+
+_JAX_ENVS = {
+    "cartpole": CartPole,
+    "pendulum": Pendulum,
+    "mountain_car_continuous": MountainCarContinuous,
+    "mountain_car": MountainCarContinuous,  # alias: env/jax_mountain_car.yaml's id
+}
+
+
+def make_jax_env(env_id: str, **env_kwargs) -> JaxEnv:
+    """Build a pure-functional env by id: ``cartpole`` / ``jax_cartpole`` /
+    ``gymnax:CartPole-v1`` / ..."""
+    name = str(env_id)
+    if name.startswith("gymnax:"):
+        from sheeprl_tpu.envs.jax.gymnax_adapter import GymnaxAdapter
+
+        return GymnaxAdapter(name.split(":", 1)[1], **env_kwargs)
+    short = name[len("jax_"):] if name.startswith("jax_") else name
+    if short in _JAX_ENVS:
+        return _JAX_ENVS[short](**env_kwargs)
+    raise ValueError(
+        f"Unknown jax env id {env_id!r}; in-tree: {sorted(_JAX_ENVS)} "
+        "(optionally prefixed 'jax_'), external: 'gymnax:<EnvName>'."
+    )
+
+
+__all__ = [
+    "CartPole",
+    "JaxEnv",
+    "MountainCarContinuous",
+    "Pendulum",
+    "make_jax_env",
+]

@@ -1,0 +1,94 @@
+"""Pure-JAX CartPole, trajectory-parity-matched to gymnasium ``CartPole-v1``.
+
+Physics constants, the euler integrator and the termination thresholds are
+copied from ``gymnasium/envs/classic_control/cartpole.py`` verbatim; the parity
+contract (``tests/test_envs/test_jax_envs.py``) steps both implementations from
+an identical physics state and asserts matching observation/reward/termination
+trajectories.  Reset distribution equivalence: gymnasium draws the 4-vector
+uniformly from ``[-0.05, 0.05]`` — so does :meth:`CartPole.reset` (different
+PRNG streams, identical distribution).  The ``TimeLimit(500)`` that
+``gymnasium.make`` adds is folded into ``params.max_episode_steps``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import JaxEnv, time_limit
+
+
+class CartPoleParams(NamedTuple):
+    gravity: float = 9.8
+    masscart: float = 1.0
+    masspole: float = 0.1
+    length: float = 0.5  # half the pole's length
+    force_mag: float = 10.0
+    tau: float = 0.02
+    theta_threshold: float = 12 * 2 * np.pi / 360
+    x_threshold: float = 2.4
+    reset_bound: float = 0.05
+    max_episode_steps: int = 500
+
+
+class CartPoleState(NamedTuple):
+    x: jax.Array
+    x_dot: jax.Array
+    theta: jax.Array
+    theta_dot: jax.Array
+    time: jax.Array
+
+
+class CartPole(JaxEnv):
+    name = "cartpole"
+
+    def default_params(self) -> CartPoleParams:
+        return CartPoleParams()
+
+    def reset(self, params: CartPoleParams, key: jax.Array) -> Tuple[CartPoleState, jax.Array]:
+        vals = jax.random.uniform(key, (4,), jnp.float32, -params.reset_bound, params.reset_bound)
+        state = CartPoleState(vals[0], vals[1], vals[2], vals[3], jnp.zeros((), jnp.int32))
+        return state, self._obs(state)
+
+    @staticmethod
+    def _obs(state: CartPoleState) -> jax.Array:
+        return jnp.stack([state.x, state.x_dot, state.theta, state.theta_dot]).astype(jnp.float32)
+
+    def step(self, params: CartPoleParams, state: CartPoleState, action: jax.Array, key: jax.Array):
+        total_mass = params.masspole + params.masscart
+        polemass_length = params.masspole * params.length
+        force = jnp.where(action == 1, params.force_mag, -params.force_mag)
+        costheta = jnp.cos(state.theta)
+        sintheta = jnp.sin(state.theta)
+        temp = (force + polemass_length * jnp.square(state.theta_dot) * sintheta) / total_mass
+        thetaacc = (params.gravity * sintheta - costheta * temp) / (
+            params.length * (4.0 / 3.0 - params.masspole * jnp.square(costheta) / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        # euler integrator (gymnasium's default kinematics_integrator)
+        x = state.x + params.tau * state.x_dot
+        x_dot = state.x_dot + params.tau * xacc
+        theta = state.theta + params.tau * state.theta_dot
+        theta_dot = state.theta_dot + params.tau * thetaacc
+        new_state = CartPoleState(x, x_dot, theta, theta_dot, state.time + 1)
+        terminated = jnp.logical_or(
+            jnp.abs(x) > params.x_threshold, jnp.abs(theta) > params.theta_threshold
+        )
+        truncated, done = time_limit(params, new_state.time, terminated)
+        reward = jnp.ones((), jnp.float32)  # 1.0 every step, including the terminating one
+        info = {"terminated": terminated, "truncated": truncated}
+        return new_state, self._obs(new_state), reward, done, info
+
+    def observation_space(self, params: CartPoleParams) -> gym.spaces.Box:
+        high = np.array(
+            [params.x_threshold * 2, np.finfo(np.float32).max, params.theta_threshold * 2, np.finfo(np.float32).max],
+            dtype=np.float32,
+        )
+        return gym.spaces.Box(-high, high, dtype=np.float32)
+
+    def action_space(self, params: CartPoleParams) -> gym.spaces.Discrete:
+        return gym.spaces.Discrete(2)

@@ -1,0 +1,59 @@
+"""Host-side gymnasium view of a :class:`~sheeprl_tpu.envs.jax.core.JaxEnv`.
+
+This is the COMPATIBILITY path, not the fast one: it lets ``env=jax_cartpole``
+run through the unchanged host training loops (``SyncVectorEnv`` and friends,
+one jitted step dispatch per env step) so host-vs-Anakin comparisons —
+``benchmarks/anakin_bench.py``'s speedup row and the trajectory-parity tests —
+exercise the SAME dynamics on both sides.  With ``algo.anakin=True`` the engine
+bypasses this wrapper entirely and vmaps the pure env inside the fused scan."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+
+class JaxToGymEnv(gym.Env):
+    metadata = {"render_modes": []}
+
+    def __init__(self, env_id: str, seed: Optional[int] = None, **env_kwargs):
+        from sheeprl_tpu.envs.jax import make_jax_env
+
+        self._env = make_jax_env(env_id, **env_kwargs)
+        self._params = self._env.default_params()
+        self.observation_space = self._env.observation_space(self._params)
+        self.action_space = self._env.action_space(self._params)
+        self._key = jax.random.PRNGKey(0 if seed is None else int(seed))
+        self._state = None
+        # Plain step (no autoreset): gymnasium's vector wrappers own the reset
+        # protocol here, exactly like any other host env.
+        self._step = jax.jit(self._env.step)
+        self._reset = jax.jit(self._env.reset)
+
+    def reset(self, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self._key = jax.random.PRNGKey(int(seed))
+        self._key, sub = jax.random.split(self._key)
+        self._state, obs = self._reset(self._params, sub)
+        return np.asarray(obs), {}
+
+    def step(self, action):
+        self._key, sub = jax.random.split(self._key)
+        if isinstance(self.action_space, gym.spaces.Discrete):
+            action = np.int32(action)
+        else:
+            action = np.asarray(action, np.float32)
+        self._state, obs, reward, _done, info = self._step(self._params, self._state, action, sub)
+        return (
+            np.asarray(obs),
+            float(reward),
+            bool(info["terminated"]),
+            bool(info["truncated"]),
+            {},
+        )
+
+    def render(self):
+        return None

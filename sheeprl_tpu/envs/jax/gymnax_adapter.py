@@ -1,0 +1,68 @@
+"""Adapter exposing external `gymnax <https://github.com/RobertTLange/gymnax>`_
+environments through the in-tree :class:`~sheeprl_tpu.envs.jax.core.JaxEnv`
+protocol, so every gymnax env plugs straight into the Anakin engine
+(``env.jax.env_id=gymnax:<EnvName>``).  gymnax is an optional dependency —
+importing this module without it raises with an actionable message, and the
+in-tree classic-control envs never touch it."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import JaxEnv
+
+
+def _to_gym_space(space) -> gym.spaces.Space:
+    """gymnax spaces → gymnasium spaces (Box/Discrete cover the gymnax registry)."""
+    kind = type(space).__name__
+    if kind == "Discrete":
+        return gym.spaces.Discrete(int(space.n))
+    if kind == "Box":
+        low = np.broadcast_to(np.asarray(space.low, np.float32), space.shape)
+        high = np.broadcast_to(np.asarray(space.high, np.float32), space.shape)
+        return gym.spaces.Box(low, high, shape=tuple(space.shape), dtype=np.float32)
+    raise ValueError(f"Unsupported gymnax space for the Anakin engine: {space!r}")
+
+
+class GymnaxAdapter(JaxEnv):
+    """Wrap ``gymnax.make(env_id)``: argument order is remapped (gymnax steps as
+    ``step_env(key, state, action, params)``), auto-reset is left to
+    :meth:`JaxEnv.step_autoreset` (gymnax's own ``step`` folds a reset in with a
+    different final-obs convention), and ``done`` is exposed as ``terminated``
+    (gymnax predates the terminated/truncated split)."""
+
+    def __init__(self, env_id: str, **env_kwargs):
+        try:
+            import gymnax
+        except ImportError as exc:  # pragma: no cover - exercised only without gymnax
+            raise ImportError(
+                f"env id 'gymnax:{env_id}' needs the optional gymnax package "
+                "(pip install gymnax); the in-tree jax envs (cartpole, pendulum, "
+                "mountain_car_continuous) work without it."
+            ) from exc
+        self._env, self._default_params = gymnax.make(env_id, **env_kwargs)
+        self.name = f"gymnax_{env_id}"
+
+    def default_params(self):
+        return self._default_params
+
+    def reset(self, params, key: jax.Array) -> Tuple:
+        obs, state = self._env.reset_env(key, params)
+        return state, jnp.asarray(obs, jnp.float32)
+
+    def step(self, params, state, action: jax.Array, key: jax.Array):
+        obs, new_state, reward, done, info = self._env.step_env(key, state, action, params)
+        done = jnp.asarray(done, bool)
+        info = {**info, "terminated": done, "truncated": jnp.zeros((), bool)}
+        return new_state, jnp.asarray(obs, jnp.float32), jnp.asarray(reward, jnp.float32), done, info
+
+    def observation_space(self, params) -> gym.spaces.Space:
+        return _to_gym_space(self._env.observation_space(params))
+
+    def action_space(self, params) -> gym.spaces.Space:
+        return _to_gym_space(self._env.action_space(params))

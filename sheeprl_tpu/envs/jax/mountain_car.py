@@ -1,0 +1,72 @@
+"""Pure-JAX continuous Mountain Car, parity-matched to gymnasium
+``MountainCarContinuous-v0`` (sparse +100 goal reward minus a quadratic action
+cost; in-graph ``TimeLimit(999)``).  Reset distribution equivalence: gymnasium
+draws ``position ~ U(-0.6, -0.4)`` with zero velocity — so does
+:meth:`MountainCarContinuous.reset`."""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import JaxEnv, time_limit
+
+
+class MountainCarParams(NamedTuple):
+    min_position: float = -1.2
+    max_position: float = 0.6
+    max_speed: float = 0.07
+    goal_position: float = 0.45
+    goal_velocity: float = 0.0
+    power: float = 0.0015
+    max_episode_steps: int = 999
+
+
+class MountainCarState(NamedTuple):
+    position: jax.Array
+    velocity: jax.Array
+    time: jax.Array
+
+
+class MountainCarContinuous(JaxEnv):
+    name = "mountain_car_continuous"
+
+    def default_params(self) -> MountainCarParams:
+        return MountainCarParams()
+
+    def reset(self, params: MountainCarParams, key: jax.Array) -> Tuple[MountainCarState, jax.Array]:
+        position = jax.random.uniform(key, (), jnp.float32, -0.6, -0.4)
+        state = MountainCarState(position, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+        return state, self._obs(state)
+
+    @staticmethod
+    def _obs(state: MountainCarState) -> jax.Array:
+        return jnp.stack([state.position, state.velocity]).astype(jnp.float32)
+
+    def step(self, params: MountainCarParams, state: MountainCarState, action: jax.Array, key: jax.Array):
+        force = jnp.clip(jnp.asarray(action, jnp.float32).reshape(-1)[0], -1.0, 1.0)
+        velocity = state.velocity + force * params.power - 0.0025 * jnp.cos(3 * state.position)
+        velocity = jnp.clip(velocity, -params.max_speed, params.max_speed)
+        position = jnp.clip(state.position + velocity, params.min_position, params.max_position)
+        # hitting the left wall kills leftward velocity (gymnasium's inelastic stop)
+        velocity = jnp.where(
+            jnp.logical_and(position == params.min_position, velocity < 0), 0.0, velocity
+        )
+        new_state = MountainCarState(position, velocity, state.time + 1)
+        terminated = jnp.logical_and(position >= params.goal_position, velocity >= params.goal_velocity)
+        truncated, done = time_limit(params, new_state.time, terminated)
+        reward = jnp.where(terminated, 100.0, 0.0) - 0.1 * force**2
+        info = {"terminated": terminated, "truncated": truncated}
+        return new_state, self._obs(new_state), reward.astype(jnp.float32), done, info
+
+    def observation_space(self, params: MountainCarParams) -> gym.spaces.Box:
+        low = np.array([params.min_position, -params.max_speed], dtype=np.float32)
+        high = np.array([params.max_position, params.max_speed], dtype=np.float32)
+        return gym.spaces.Box(low, high, dtype=np.float32)
+
+    def action_space(self, params: MountainCarParams) -> gym.spaces.Box:
+        return gym.spaces.Box(-1.0, 1.0, (1,), np.float32)

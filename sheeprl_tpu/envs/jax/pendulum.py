@@ -1,0 +1,76 @@
+"""Pure-JAX Pendulum, parity-matched to gymnasium ``Pendulum-v1`` (the SAC-family
+Anakin workhorse: continuous actions, dense reward, never terminates — episodes
+end only on the in-graph ``TimeLimit(200)``).  Reset distribution equivalence:
+gymnasium draws ``theta ~ U(-pi, pi)``, ``theta_dot ~ U(-1, 1)`` — so does
+:meth:`Pendulum.reset`."""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import JaxEnv, time_limit
+
+
+class PendulumParams(NamedTuple):
+    max_speed: float = 8.0
+    max_torque: float = 2.0
+    dt: float = 0.05
+    g: float = 10.0
+    m: float = 1.0
+    l: float = 1.0
+    max_episode_steps: int = 200
+
+
+class PendulumState(NamedTuple):
+    theta: jax.Array
+    theta_dot: jax.Array
+    time: jax.Array
+
+
+def _angle_normalize(x: jax.Array) -> jax.Array:
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class Pendulum(JaxEnv):
+    name = "pendulum"
+
+    def default_params(self) -> PendulumParams:
+        return PendulumParams()
+
+    def reset(self, params: PendulumParams, key: jax.Array) -> Tuple[PendulumState, jax.Array]:
+        high = jnp.asarray([jnp.pi, 1.0], jnp.float32)
+        vals = jax.random.uniform(key, (2,), jnp.float32, -high, high)
+        state = PendulumState(vals[0], vals[1], jnp.zeros((), jnp.int32))
+        return state, self._obs(state)
+
+    @staticmethod
+    def _obs(state: PendulumState) -> jax.Array:
+        return jnp.stack([jnp.cos(state.theta), jnp.sin(state.theta), state.theta_dot]).astype(jnp.float32)
+
+    def step(self, params: PendulumParams, state: PendulumState, action: jax.Array, key: jax.Array):
+        u = jnp.clip(jnp.asarray(action, jnp.float32).reshape(-1)[0], -params.max_torque, params.max_torque)
+        costs = (
+            _angle_normalize(state.theta) ** 2 + 0.1 * state.theta_dot**2 + 0.001 * (u**2)
+        )
+        newthdot = state.theta_dot + (
+            3 * params.g / (2 * params.l) * jnp.sin(state.theta) + 3.0 / (params.m * params.l**2) * u
+        ) * params.dt
+        newthdot = jnp.clip(newthdot, -params.max_speed, params.max_speed)
+        newth = state.theta + newthdot * params.dt
+        new_state = PendulumState(newth, newthdot, state.time + 1)
+        terminated = jnp.zeros((), bool)
+        truncated, done = time_limit(params, new_state.time, terminated)
+        info = {"terminated": terminated, "truncated": truncated}
+        return new_state, self._obs(new_state), (-costs).astype(jnp.float32), done, info
+
+    def observation_space(self, params: PendulumParams) -> gym.spaces.Box:
+        high = np.array([1.0, 1.0, params.max_speed], dtype=np.float32)
+        return gym.spaces.Box(-high, high, dtype=np.float32)
+
+    def action_space(self, params: PendulumParams) -> gym.spaces.Box:
+        return gym.spaces.Box(-params.max_torque, params.max_torque, (1,), np.float32)

@@ -1,0 +1,336 @@
+"""Anakin training mode (``sheeprl_tpu/engine/anakin.py``): the ISSUE-6
+correctness contracts.
+
+* the fused PPO iteration's update is BIT-IDENTICAL to the standalone jitted
+  ``PPOTrainFns.train_fn`` on the same collected batch (only the collection path
+  changes);
+* the scan carry (env states, ring + counters, PRNG key, params, opt state)
+  round-trips through ``CheckpointManager`` and the CLI resume path continues a
+  run mid-Anakin;
+* the flight recorder stages a post-dispatch device-side COPY of the carry (the
+  dispatch donates its input), and a strict-mode NaN crash dumps + replays;
+* CLI e2e smokes for ``exp=ppo env=jax_cartpole algo.anakin=True`` and the SAC
+  path on ``jax_pendulum``.
+"""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.config.core import compose
+from sheeprl_tpu.envs.jax import make_jax_env
+from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
+
+PPO_ANAKIN_ARGS = [
+    "exp=ppo",
+    "env=jax_cartpole",
+    "algo.anakin=True",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=8",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.encoder.mlp_features_dim=8",
+]
+
+SAC_ANAKIN_ARGS = [
+    "exp=sac",
+    "env=jax_pendulum",
+    "algo.anakin=True",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.hidden_size=8",
+    "algo.per_rank_batch_size=8",
+    "algo.learning_starts=8",
+    "algo.total_steps=64",
+    "algo.anakin_steps_per_dispatch=8",
+    "buffer.size=256",
+]
+
+
+def standard_args(tmp_path, extra=()):
+    return [
+        "dry_run=True",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "checkpoint.every=1",
+        "checkpoint.save_last=True",
+        "metric.log_every=1",
+        f"log_root={tmp_path}",
+        "buffer.memmap=False",
+        "algo.run_test=False",
+        *extra,
+    ]
+
+
+def _ckpts(tmp_path):
+    return sorted(tmp_path.rglob("ckpt_*"), key=lambda p: p.stat().st_mtime)
+
+
+def _ppo_setup(num_envs=2, update_epochs=2):
+    cfg = compose(
+        overrides=PPO_ANAKIN_ARGS
+        + [f"algo.update_epochs={update_epochs}", f"env.num_envs={num_envs}",
+           "env.capture_video=False", "buffer.memmap=False"]
+    )
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+    from sheeprl_tpu.engine.anakin import init_episode_stats, reset_envs
+
+    env = make_jax_env("cartpole")
+    env_params = env.default_params()
+    obs_space = gym.spaces.Dict({"state": env.observation_space(env_params)})
+    agent, params = build_agent(ctx, env.action_space(env_params), obs_space, cfg)
+    fns = PPOTrainFns(ctx, agent, cfg, ["state"], 4)
+    opt_state = ctx.replicate(fns.opt.init(params))
+    env_state, obs0 = reset_envs(env, env_params, num_envs, jax.random.PRNGKey(7))
+    carry = {
+        "params": params,
+        "opt_state": opt_state,
+        "env_state": env_state,
+        "obs": obs0,
+        "key": jax.random.PRNGKey(3),
+        "episode_stats": init_episode_stats(num_envs),
+    }
+    return cfg, ctx, env, env_params, agent, fns, carry
+
+
+def test_ppo_anakin_update_bit_identical_to_host_train_fn():
+    """The acceptance contract: given the same collected batch and key, the fused
+    Anakin iteration's update produces EXACTLY the host ``train_fn``'s params and
+    metrics — only the collection path changed."""
+    from sheeprl_tpu.engine.anakin import make_ppo_anakin_iteration
+
+    cfg, ctx, env, env_params, agent, fns, carry = _ppo_setup()
+    iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, "state", return_batch=True)
+    new_carry, metrics, data, k_train = jax.jit(iteration)(carry, 0.2, 0.01)
+
+    p2, _o2, m2 = fns.train_fn(
+        carry["params"], carry["opt_state"], jax.device_get(data), k_train, 0.2, 0.01
+    )
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(new_carry["params"]), jax.tree.leaves(p2)
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"params diverged at {jax.tree_util.keystr(path)}"
+        )
+    for k in m2:
+        np.testing.assert_array_equal(np.asarray(metrics[k]), np.asarray(m2[k]), err_msg=k)
+
+
+def test_ppo_anakin_carry_roundtrips_through_checkpoint_manager(tmp_path):
+    """Scan-carry state (env states incl. NamedTuples, PRNG key, opt state,
+    episode accumulators) survives a CheckpointManager save/load bit-exactly."""
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+
+    cfg, ctx, env, env_params, agent, fns, carry = _ppo_setup()
+    from sheeprl_tpu.engine.anakin import make_ppo_anakin_iteration
+
+    dispatch = jax.jit(make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, "state"))
+    carry, _metrics = dispatch(carry, 0.2, 0.0)  # a non-trivial mid-run carry
+
+    mgr = CheckpointManager(tmp_path / "ckpts", keep_last=2)
+    mgr.save(1, {"carry": carry, "update": 1, "policy_step": 16})
+    template = jax.tree.map(lambda x: None, jax.device_get(carry))
+    state = CheckpointManager.load(mgr.list_checkpoints()[-1], templates={"carry": jax.device_get(carry)})
+    del template
+    assert state["update"] == 1 and state["policy_step"] == 16
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(jax.device_get(carry)), jax.tree.leaves(state["carry"])
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"carry leaf {jax.tree_util.keystr(path)}"
+        )
+
+
+def test_sac_anakin_ring_counters_roundtrip(tmp_path):
+    """SAC-side resume contract: ring arrays + rows_added/gstep counters live in
+    the carry and restore exactly (the in-jit sampler derives from them)."""
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+    from sheeprl_tpu.data.device_buffer import STAMP_KEY, DeviceTransitionRing
+    from sheeprl_tpu.engine.anakin import init_episode_stats, make_sac_anakin_dispatch, reset_envs
+
+    cfg = compose(
+        overrides=SAC_ANAKIN_ARGS
+        + ["env.num_envs=2", "env.capture_video=False", "buffer.memmap=False"]
+    )
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+    env = make_jax_env("pendulum")
+    env_params = env.default_params()
+    obs_space = gym.spaces.Dict({"state": env.observation_space(env_params)})
+    act_space = env.action_space(env_params)
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    params = jax.tree.map(jnp.copy, params)
+    ring = DeviceTransitionRing(
+        16, 2, {"obs": ((3,), jnp.float32), "next_obs": ((3,), jnp.float32),
+                "actions": ((1,), jnp.float32), "rewards": ((1,), jnp.float32),
+                "dones": ((1,), jnp.float32)}
+    )
+    actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
+        env, env_params, actor, critic, cfg, act_space, ring, 4
+    )
+    carry = {
+        "params": params,
+        "opt_state": {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        },
+        "env_state": reset_envs(env, env_params, 2, jax.random.PRNGKey(0))[0],
+        "obs": reset_envs(env, env_params, 2, jax.random.PRNGKey(0))[1],
+        "ring": ring.arrays,
+        "rows_added": jnp.zeros((), jnp.int32),
+        "gstep": jnp.zeros((), jnp.int32),
+        "key": jax.random.PRNGKey(1),
+        "episode_stats": init_episode_stats(2),
+    }
+    dispatch = jax.jit(builder(5, 1, True), donate_argnums=(0,))
+    carry, _metrics = dispatch(carry)
+    assert int(jax.device_get(carry["rows_added"])) == 5
+    assert int(jax.device_get(carry["gstep"])) == 5
+    stamps = np.asarray(jax.device_get(carry["ring"][STAMP_KEY]))
+    np.testing.assert_array_equal(stamps[:, :5, 0], np.broadcast_to(np.arange(5), (2, 5)))
+
+    mgr = CheckpointManager(tmp_path / "ckpts", keep_last=1)
+    mgr.save(5, {"carry": carry})
+    state = CheckpointManager.load(mgr.list_checkpoints()[-1], templates={"carry": jax.device_get(carry)})
+    assert int(state["carry"]["rows_added"]) == 5
+    np.testing.assert_array_equal(
+        np.asarray(state["carry"]["ring"][STAMP_KEY]), stamps
+    )
+
+
+def test_ppo_anakin_flight_recorder_stages_carry_copy():
+    """Post-dispatch staging: the recorder holds a device-side COPY of the carry
+    (the donated originals are dead), fetchable without error."""
+    from sheeprl_tpu.engine.anakin import make_ppo_anakin_iteration, stage_carry
+    from sheeprl_tpu.obs import flight_recorder
+
+    cfg, ctx, env, env_params, agent, fns, carry = _ppo_setup()
+    dispatch = jax.jit(
+        make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, "state"), donate_argnums=(0,)
+    )
+    recorder = flight_recorder.FlightRecorder("/tmp/unused", capacity=16)
+    carry, _metrics = dispatch(carry, 0.2, 0.0)
+    stage_carry(recorder, carry, update=1, clip_coef=0.2, ent_coef=0.0)
+    assert recorder.staged_updates == 1
+    staged = recorder._staged["carry"]
+    carry2, _metrics2 = dispatch(carry, 0.2, 0.0)  # donates the staged copy's source
+    # the staged copy must still be alive and fetchable after the donation
+    fetched = jax.device_get(staged["params"])
+    assert all(np.isfinite(x).all() for x in jax.tree.leaves(fetched))
+    del carry2
+
+
+def test_ppo_anakin_cli_smoke_and_resume(tmp_path):
+    run(PPO_ANAKIN_ARGS + ["algo.total_steps=32"] + standard_args(tmp_path))
+    ckpts = _ckpts(tmp_path)
+    assert ckpts, "no checkpoint written"
+    run(
+        PPO_ANAKIN_ARGS
+        + ["algo.total_steps=32", f"checkpoint.resume_from={ckpts[-1]}"]
+        + standard_args(tmp_path)
+    )
+
+
+def test_ppo_anakin_evaluate_roundtrip(tmp_path):
+    """Anakin checkpoints store the scan carry; the eval entry digs the policy
+    params out of it and runs the greedy episode through the host adapter."""
+    from sheeprl_tpu.cli import evaluate
+
+    run(PPO_ANAKIN_ARGS + ["algo.total_steps=32"] + standard_args(tmp_path))
+    ckpts = _ckpts(tmp_path)
+    assert ckpts
+    evaluate([f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+def test_sac_anakin_cli_smoke_and_resume(tmp_path):
+    run(SAC_ANAKIN_ARGS + standard_args(tmp_path, extra=["dry_run=False", "checkpoint.every=16", "metric.log_every=16"]))
+    ckpts = _ckpts(tmp_path)
+    assert ckpts, "no checkpoint written"
+    run(
+        SAC_ANAKIN_ARGS
+        + [f"checkpoint.resume_from={ckpts[-1]}", "algo.total_steps=96"]
+        + standard_args(tmp_path, extra=["dry_run=False", "checkpoint.every=16", "metric.log_every=16"])
+    )
+
+
+def test_sac_anakin_rejects_fractional_replay_ratio(tmp_path):
+    with pytest.raises(ValueError, match="integer algo.replay_ratio"):
+        run(
+            SAC_ANAKIN_ARGS
+            + ["algo.replay_ratio=0.5"]
+            + standard_args(tmp_path, extra=["dry_run=False"])
+        )
+
+
+def test_anakin_requires_jax_env(tmp_path):
+    with pytest.raises(ValueError, match="on-device JAX environment"):
+        run(
+            [
+                "exp=ppo",
+                "env=discrete_dummy",
+                "algo.anakin=True",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.rollout_steps=8",
+                "algo.per_rank_batch_size=8",
+            ]
+            + standard_args(tmp_path)
+        )
+
+
+def test_ppo_anakin_nan_injection_dumps_and_replays(tmp_path):
+    """Strict-mode crash forensics mid-Anakin: injected NaN -> NonFiniteError ->
+    blackbox dump with the staged carry -> replay re-executes the fused dispatch
+    on CPU and reproduces the non-finite metrics."""
+    from sheeprl_tpu.analysis.strict import NonFiniteError
+    from sheeprl_tpu.obs import replay_blackbox
+
+    with pytest.raises(NonFiniteError, match="inject_nan"):
+        run(
+            PPO_ANAKIN_ARGS
+            + ["analysis.strict=True", "analysis.inject_nan=True"]
+            + standard_args(tmp_path, extra=["checkpoint.every=0", "checkpoint.save_last=False"])
+        )
+    dumps = list(tmp_path.rglob("blackbox"))
+    assert dumps, "no blackbox directory written"
+    outputs, nonfinite = replay_blackbox.replay(dumps[0])
+    assert nonfinite, "replay did not reproduce the injected non-finite metrics"
+
+
+def test_anakin_exp_presets_compose():
+    for exp in ("ppo_anakin", "sac_anakin"):
+        cfg = compose(overrides=[f"exp={exp}"])
+        assert cfg.algo.anakin and cfg.env.jax.enabled and cfg.env.jax.env_id
+        assert cfg.algo.mlp_keys.encoder == ["state"]
+
+
+def test_anakin_bench_smoke(capsys):
+    """Tier-1 smoke of benchmarks/anakin_bench.py at tiny shapes: both rows print
+    with the expected fields (the acceptance speedup is asserted only on real
+    hardware runs, not on the shared CI box)."""
+    import json
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "benchmarks"))
+    try:
+        import anakin_bench
+    finally:
+        sys.path.pop(0)
+    anakin_bench.main(
+        ["--num-envs", "8", "--steps", "64", "--host-steps", "16", "--rollout-steps", "8",
+         "--ppo-envs", "4", "--iters", "2", "--host-envs", "2"]
+    )
+    rows = [json.loads(line) for line in capsys.readouterr().out.splitlines() if line.strip()]
+    by_metric = {r["metric"]: r for r in rows}
+    assert set(by_metric) == {"anakin_cartpole_steps_per_sec", "anakin_ppo_grad_steps_per_sec"}
+    row = by_metric["anakin_cartpole_steps_per_sec"]
+    assert row["value"] > 0 and row["speedup_vs_host"] > 0
+    assert "host_sync_vector_steps_per_sec" in row and "speedup_vs_raw_gym_saturated" in row
+    assert by_metric["anakin_ppo_grad_steps_per_sec"]["value"] > 0

@@ -139,6 +139,28 @@ def test_jl002_negative_static_conditions(lint):
     assert "JL002" not in rule_ids(findings)
 
 
+def test_jl002_negative_autoreset_cond_select(lint):
+    """The jax-env auto-reset idiom (``envs/jax/core.py``) is the JL002-CLEAN way
+    to branch on a traced ``done``: both branches computed, merged with
+    ``lax.select`` over the state tree (or ``lax.cond`` for whole-branch
+    dispatch) — no python ``if`` ever touches the traced flag.  Pinned here so
+    the pattern stays lint-clean as the rule evolves."""
+    findings = lint(
+        """
+        import jax
+
+        def step_autoreset(params, state, action, key):
+            key_step, key_reset = jax.random.split(key)
+            stepped, obs_st, reward, done, info = env_step(params, state, action, key_step)
+            reset_state, reset_obs = env_reset(params, key_reset)
+            state = jax.tree.map(lambda r, s: jax.lax.select(done, r, s), reset_state, stepped)
+            obs = jax.lax.cond(done, lambda _: reset_obs, lambda _: obs_st, None)
+            return state, obs, reward, done, info
+        """
+    )
+    assert "JL002" not in rule_ids(findings)
+
+
 # ------------------------------------------------------------------------- JL003
 def test_jl003_positive_host_sync_in_loop(lint):
     findings = lint(

@@ -213,3 +213,34 @@ def test_transition_ring_resume_rebuild():
     )
     for k in ("obs", "next_obs", "actions", "rewards", "dones", STAMP_KEY):
         np.testing.assert_array_equal(rebuilt.host_rows(k), ring.host_rows(k), err_msg=k)
+
+
+def test_transition_ring_scan_writer_matches_add_step():
+    """The Anakin engine's in-scan writer (``make_scan_writer``) must produce the
+    EXACT ring + stamp planes the host-side donated ``add_step`` scatter does —
+    including wrap-around — so ``make_sample_gather`` and ``Health/replay_age_*``
+    behave identically whichever path fed the ring."""
+    n_envs, cap, steps = 3, 8, 13  # wraps
+    rng = np.random.default_rng(5)
+    rows = [_transition_row(rng, n_envs, t) for t in range(steps)]
+
+    host = DeviceTransitionRing(cap, n_envs, _transition_specs())
+    for t, row in enumerate(rows):
+        host.add_step(row, t % cap, t)
+
+    scanned = DeviceTransitionRing(cap, n_envs, _transition_specs())
+    write = scanned.make_scan_writer()
+
+    @jax.jit
+    def run(arrays, stacked):
+        def step(arrays, x):
+            row, t = x
+            return write(arrays, row, t), None
+
+        arrays, _ = jax.lax.scan(step, arrays, (stacked, jnp.arange(steps, dtype=jnp.int32)))
+        return arrays
+
+    stacked = {k: jnp.asarray(np.concatenate([r[k] for r in rows], 0)) for k in rows[0]}
+    scanned.arrays = run(scanned.arrays, stacked)
+    for k in ("obs", "next_obs", "actions", "rewards", "dones", STAMP_KEY):
+        np.testing.assert_array_equal(scanned.host_rows(k), host.host_rows(k), err_msg=k)
