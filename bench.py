@@ -268,7 +268,46 @@ def bench_anakin() -> list:
     return [json.loads(line) for line in buf.getvalue().splitlines() if line.strip()]
 
 
+def bench_ir_audit() -> dict:
+    """Wall-clock of the full ``jaxlint-ir`` audit (``sheeprl_tpu/analysis/ir``):
+    AOT-lower + compile + rule-check every entry point's jitted update and both
+    Anakin dispatches against ``irbudgets.json``.  The CI ir-audit job runs this
+    on every PR, so its runtime is a first-class budget: the row must stay under
+    ~120 s on one CPU core.  Runs in a SUBPROCESS on the CPU backend (the audit
+    pins JAX_PLATFORMS=cpu; this process may hold a TPU).  Set ``BENCH_IR=0`` to
+    skip."""
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "SHEEPRL_TPU_QUIET": "1"}
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu.analysis.ir", "-q"],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=int(os.environ.get("BENCH_IR_TIMEOUT", "900")),
+    )
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "ir_audit_seconds",
+        "value": round(elapsed, 2),
+        "unit": "seconds (full jaxlint-ir audit: 15 programs lowered+compiled+checked, 1 CPU core)",
+        "exit_code": proc.returncode,
+        "findings": proc.stdout.count("\n") if proc.returncode else 0,
+        "budget_seconds": 120,
+        "within_budget": bool(elapsed < 120),
+    }
+
+
 def main() -> None:
+    # IR-audit wall-clock row (ISSUE-7): the static-analysis tier's own budget.
+    if os.environ.get("BENCH_IR", "1") != "0":
+        try:
+            print(json.dumps(bench_ir_audit()))
+        except Exception as exc:
+            print(json.dumps({"metric": "ir_audit_seconds", "error": str(exc)[:200]}))
     # Anakin fused-scan rows first (ISSUE-6): the collector parses the LAST JSON
     # line as the headline metric, so auxiliary rows print before it.
     if os.environ.get("BENCH_ANAKIN", "1") != "0":

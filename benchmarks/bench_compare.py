@@ -15,9 +15,14 @@ reports the relative change is computed and classified:
 * latency-like metrics (name/unit contains ``ms``, ``time``, ``latency`` or
   ``seconds``) regress when the value RISES by more than ``--threshold``.
 
-Exit code is 0 unless ``--strict`` is given and regressions were found — CI wires
-this as a non-blocking warning step (``continue-on-error``), so a slow metric
-shows up in the job log without failing the build.
+A metric present in the baseline but missing from the latest report is a
+DROPPED metric — reported loudly (a silently-vanished benchmark is not a pass),
+and treated like a regression under ``--strict``.
+
+Exit code is 0 unless ``--strict`` is given and regressions (or dropped metrics)
+were found — CI wires this as a non-blocking warning step
+(``continue-on-error``), so a slow metric shows up in the job log without
+failing the build.
 """
 
 from __future__ import annotations
@@ -98,11 +103,17 @@ def compare(base_path: str, new_path: str, threshold: float = 0.10) -> dict:
                 "regressed": regressed,
             }
         )
+    dropped = sorted(set(base) - set(new))
     return {
         "base": base_path,
         "new": new_path,
         "threshold": threshold,
-        "only_in_base": sorted(set(base) - set(new)),
+        # A metric present in the baseline but ABSENT from the latest report is
+        # not a pass — it means the benchmark silently stopped being measured
+        # (renamed row, crashed collector, skipped env gate).  Surface it as
+        # loudly as a regression; --strict fails on it.
+        "only_in_base": dropped,
+        "dropped_metrics": dropped,
         "only_in_new": sorted(set(new) - set(base)),
         "rows": rows,
         "regressions": [r["metric"] for r in rows if r["regressed"]],
@@ -133,8 +144,14 @@ def format_table(report: dict) -> str:
     lines.append("-" * (sum(widths) + 2 * (len(widths) - 1)))
     for t in table:
         lines.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
-    for name in report["only_in_base"]:
-        lines.append(f"(dropped metric: {name})")
+    dropped = report.get("dropped_metrics", report["only_in_base"])
+    if dropped:
+        lines.append(
+            f"WARNING: {len(dropped)} metric(s) present in the baseline DISAPPEARED "
+            "from the latest report — a silently-dropped benchmark is not a pass:"
+        )
+        for name in dropped:
+            lines.append(f"  DROPPED: {name}")
     for name in report["only_in_new"]:
         lines.append(f"(new metric: {name})")
     if report["regressions"]:
@@ -156,7 +173,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--latest", type=int, metavar="N", help="compare the two newest of the N latest BENCH_*.json in the CWD")
     parser.add_argument("--threshold", type=float, default=0.10, help="relative regression threshold (default 0.10)")
     parser.add_argument("--json", action="store_true", help="emit the JSON report")
-    parser.add_argument("--strict", action="store_true", help="exit 1 when regressions are found")
+    parser.add_argument(
+        "--strict", action="store_true", help="exit 1 when regressions or dropped metrics are found"
+    )
     args = parser.parse_args(argv)
 
     if args.latest:
@@ -172,7 +191,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     report = compare(base_path, new_path, threshold=args.threshold)
     print(json.dumps(report, indent=1) if args.json else format_table(report))
-    return 1 if args.strict and report["regressions"] else 0
+    if report["dropped_metrics"]:
+        print(
+            f"bench_compare: WARNING — dropped metric(s): {', '.join(report['dropped_metrics'])}",
+            file=sys.stderr,
+        )
+    return 1 if args.strict and (report["regressions"] or report["dropped_metrics"]) else 0
 
 
 if __name__ == "__main__":

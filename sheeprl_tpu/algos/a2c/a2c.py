@@ -286,3 +286,51 @@ def replay_update(cfg, dump_dir):
         "metrics": jax.device_get(metrics),
         "new_param_norm": float(jax.device_get(optax.global_norm(new_params))),
     }
+
+
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): the jitted full-batch
+    A2C update at tiny synthetic shapes, through ``make_a2c_train_fn``."""
+    from sheeprl_tpu.analysis.ir.synth import (
+        compose_tiny,
+        discrete_act_space,
+        tiny_ctx,
+        vector_space,
+        zeros,
+    )
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+
+    cfg = compose_tiny(
+        [
+            "exp=a2c",
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=4",
+            "algo.per_rank_batch_size=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.mlp_features_dim=8",
+            "env.num_envs=2",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    agent, params = build_agent(ctx, discrete_act_space(), vector_space(), cfg)
+    opt, train_fn = make_a2c_train_fn(ctx, agent, cfg, ["state"])
+    opt_state = opt.init(params)
+    n = int(cfg.algo.rollout_steps * cfg.env.num_envs)
+    data = {
+        "state": zeros((n, 5)),
+        "actions": zeros((n, 1)),
+        "values": zeros((n,)),
+        "returns": zeros((n,)),
+        "advantages": zeros((n,)),
+    }
+    return [
+        AuditEntry(
+            name="a2c/train_fn",
+            fn=train_fn,
+            args=(params, opt_state, data),
+            covers=("a2c",),
+            precision=str(cfg.mesh.precision),
+        )
+    ]

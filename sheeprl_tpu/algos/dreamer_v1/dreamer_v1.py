@@ -485,3 +485,49 @@ def main(ctx, cfg) -> None:
             logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
     if logger is not None:
         logger.close()
+
+
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): the DreamerV1
+    gradient block (``make_train_step`` in the dispatcher's ``make_train_block``
+    scan; DV1 has no target network) at tiny MLP-only synthetic shapes."""
+    from sheeprl_tpu.analysis.ir.synth import (
+        DREAMER_TINY_OVERRIDES,
+        compose_tiny,
+        sequence_batch,
+        tiny_ctx,
+        vector_space,
+    )
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+    from sheeprl_tpu.utils.blocks import make_train_block
+
+    cfg = compose_tiny(["exp=dreamer_v1_dummy", "env=discrete_dummy", *DREAMER_TINY_OVERRIDES])
+    ctx = tiny_ctx(cfg)
+    obs_space = vector_space()
+    actions_dim, is_continuous = (3,), False
+    world_model, actor, critic, params, _ = build_agent(ctx, actions_dim, is_continuous, cfg, obs_space)
+    train_step, init_opt_states = make_train_step(world_model, actor, critic, cfg, [], ["state"])
+    carry = (params, init_opt_states(params))
+
+    def _block_step(carry, batch, key, update_target):
+        del update_target  # DV1 has no target network
+        params, opt_states = carry
+        params, opt_states, metrics = train_step(params, opt_states, batch, key)
+        return (params, opt_states), metrics
+
+    block = make_train_block(_block_step, 1, 1)
+    batch = sequence_batch(
+        {"state": obs_space["state"].shape},
+        act_dim=int(sum(actions_dim)),
+        T=int(cfg.algo.per_rank_sequence_length),
+        B=int(cfg.algo.per_rank_batch_size),
+    )
+    return [
+        AuditEntry(
+            name="dreamer_v1/train_block",
+            fn=block,
+            args=(carry, (batch,), jax.random.PRNGKey(0), 0),
+            covers=("dreamer_v1", "p2e_dv1_finetuning"),
+            precision=str(cfg.mesh.precision),
+        )
+    ]

@@ -568,3 +568,52 @@ def main(ctx, cfg) -> None:
             logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
     if logger is not None:
         logger.close()
+
+
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): the DreamerV2
+    gradient block (``make_train_step`` in the dispatcher's ``make_train_block``
+    scan, hard target copies on the DV2 ``count_offset=0`` cadence) at tiny
+    MLP-only synthetic shapes."""
+    from sheeprl_tpu.analysis.ir.synth import (
+        DREAMER_DISCRETE_OVERRIDES,
+        DREAMER_TINY_OVERRIDES,
+        compose_tiny,
+        sequence_batch,
+        tiny_ctx,
+        vector_space,
+    )
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+    from sheeprl_tpu.utils.blocks import make_train_block
+
+    cfg = compose_tiny(
+        ["exp=dreamer_v2_dummy", "env=discrete_dummy", *DREAMER_TINY_OVERRIDES, *DREAMER_DISCRETE_OVERRIDES]
+    )
+    ctx = tiny_ctx(cfg)
+    obs_space = vector_space()
+    actions_dim, is_continuous = (3,), False
+    world_model, actor, critic, params, _ = build_agent(ctx, actions_dim, is_continuous, cfg, obs_space)
+    train_step, init_opt_states = make_train_step(world_model, actor, critic, cfg, [], ["state"])
+    carry = (params, init_opt_states(params))
+
+    def _block_step(carry, batch, key, update_target):
+        params, opt_states = carry
+        params, opt_states, metrics = train_step(params, opt_states, batch, key, update_target)
+        return (params, opt_states), metrics
+
+    block = make_train_block(_block_step, cfg.algo.critic.per_rank_target_network_update_freq, 0)
+    batch = sequence_batch(
+        {"state": obs_space["state"].shape},
+        act_dim=int(sum(actions_dim)),
+        T=int(cfg.algo.per_rank_sequence_length),
+        B=int(cfg.algo.per_rank_batch_size),
+    )
+    return [
+        AuditEntry(
+            name="dreamer_v2/train_block",
+            fn=block,
+            args=(carry, (batch,), jax.random.PRNGKey(0), 0),
+            covers=("dreamer_v2", "p2e_dv2_finetuning"),
+            precision=str(cfg.mesh.precision),
+        )
+    ]

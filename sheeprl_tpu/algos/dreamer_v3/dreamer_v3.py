@@ -744,3 +744,55 @@ def replay_update(cfg, dump_dir):
         start_count += size
         last_metrics = jax.device_get(metrics)
     return {"metrics": last_metrics}
+
+
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): the DreamerV3
+    gradient block — ``make_train_step`` wrapped in the same ``make_train_block``
+    scan the dispatcher jits — at tiny MLP-only synthetic shapes."""
+    from sheeprl_tpu.analysis.ir.synth import (
+        DREAMER_DISCRETE_OVERRIDES,
+        DREAMER_TINY_OVERRIDES,
+        compose_tiny,
+        sequence_batch,
+        tiny_ctx,
+        vector_space,
+    )
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+    from sheeprl_tpu.utils.blocks import make_train_block
+
+    cfg = compose_tiny(
+        ["exp=dreamer_v3_dummy", "env=discrete_dummy", *DREAMER_TINY_OVERRIDES, *DREAMER_DISCRETE_OVERRIDES]
+    )
+    ctx = tiny_ctx(cfg)
+    obs_space = vector_space()
+    actions_dim, is_continuous = (3,), False
+    world_model, actor, critic, params, _ = build_agent(ctx, actions_dim, is_continuous, cfg, obs_space)
+    train_step, init_opt_states = make_train_step(
+        world_model, actor, critic, cfg, [], ["state"], {"state": obs_space["state"].shape}
+    )
+    carry = (params, init_opt_states(params), init_moments())
+
+    def _block_step(carry, batch, key, update_target):
+        params, opt_states, moments = carry
+        params, opt_states, moments, metrics = train_step(
+            params, opt_states, moments, batch, key, update_target
+        )
+        return (params, opt_states, moments), metrics
+
+    block = make_train_block(_block_step, cfg.algo.critic.per_rank_target_network_update_freq, 1)
+    batch = sequence_batch(
+        {"state": obs_space["state"].shape},
+        act_dim=int(sum(actions_dim)),
+        T=int(cfg.algo.per_rank_sequence_length),
+        B=int(cfg.algo.per_rank_batch_size),
+    )
+    return [
+        AuditEntry(
+            name="dreamer_v3/train_block",
+            fn=block,
+            args=(carry, (batch,), jax.random.PRNGKey(0), 0),
+            covers=("dreamer_v3", "p2e_dv3_finetuning"),
+            precision=str(cfg.mesh.precision),
+        )
+    ]

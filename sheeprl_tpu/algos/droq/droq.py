@@ -620,3 +620,60 @@ def main(ctx, cfg) -> None:
             logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
     if logger is not None:
         logger.close()
+
+
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): DroQ's whole fused
+    UTD block — K scanned critic updates plus the once-per-iteration actor tail —
+    as ONE donated jit, the exact program ``FusedRingDispatcher`` dispatches."""
+    from sheeprl_tpu.analysis.ir.synth import box_act_space, compose_tiny, tiny_ctx, transition_ring
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+
+    cfg = compose_tiny(
+        [
+            "exp=droq",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=4",
+            "env.num_envs=2",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    act_space = box_act_space()
+    obs_dim, act_dim, batch_size = 5, 2, 4
+    actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size, dtype=ctx.compute_dtype)
+    critic = DroQCriticEnsemble(
+        n_critics=cfg.algo.critic.n,
+        hidden_size=cfg.algo.critic.hidden_size,
+        dropout=cfg.algo.critic.dropout,
+        dtype=ctx.compute_dtype,
+    )
+    dummy_obs, dummy_act = jnp.zeros((1, obs_dim)), jnp.zeros((1, act_dim))
+    params = {
+        "actor": actor.init(ctx.rng(), dummy_obs),
+        "critic": critic.init({"params": ctx.rng(), "dropout": ctx.rng()}, dummy_obs, dummy_act),
+        "log_alpha": jnp.asarray(jnp.log(cfg.algo.alpha.alpha), dtype=jnp.float32),
+    }
+    params["critic_target"] = jax.tree.map(jnp.copy, params["critic"])
+
+    ring, filled, rows_added = transition_ring(obs_dim=obs_dim, act_dim=act_dim)
+    actor_opt, critic_opt, alpha_opt, builder = make_droq_fused_builder(
+        actor, critic, cfg, act_space, ring, batch_size
+    )
+    opt_state = {
+        "actor": actor_opt.init(params["actor"]),
+        "critic": critic_opt.init(params["critic"]),
+        "alpha": alpha_opt.init(params["log_alpha"]),
+    }
+    block = jax.jit(builder(2, True), donate_argnums=(0,))
+    carry = {"params": params, "opt_state": opt_state}
+    return [
+        AuditEntry(
+            name="droq/fused_block",
+            fn=block,
+            args=(carry, ring.arrays, filled, rows_added, jax.random.PRNGKey(0), 0),
+            covers=("droq",),
+            precision=str(cfg.mesh.precision),
+        )
+    ]

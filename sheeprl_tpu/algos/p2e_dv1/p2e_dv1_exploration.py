@@ -561,3 +561,62 @@ def main(ctx, cfg) -> None:
             logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
     if logger is not None:
         logger.close()
+
+
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): the P2E-DV1
+    exploration gradient block (world model + task/exploration heads + intrinsic
+    ensembles in one ``make_train_block`` scan) at tiny MLP-only shapes."""
+    from sheeprl_tpu.analysis.ir.synth import (
+        DREAMER_TINY_OVERRIDES,
+        compose_tiny,
+        sequence_batch,
+        tiny_ctx,
+        vector_space,
+    )
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+    from sheeprl_tpu.utils.blocks import make_train_block
+
+    cfg = compose_tiny(
+        [
+            "exp=p2e_dv1_dummy",
+            "env=discrete_dummy",
+            *DREAMER_TINY_OVERRIDES,
+            "algo.ensembles.n=2",
+            "algo.ensembles.dense_units=8",
+            "algo.ensembles.mlp_layers=1",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    obs_space = vector_space()
+    actions_dim, is_continuous = (3,), False
+    world_model, actor, critic, ensemble_mlp, params, _ = build_agent(
+        ctx, actions_dim, is_continuous, cfg, obs_space
+    )
+    train_step, init_opt_states = make_train_step(
+        world_model, actor, critic, ensemble_mlp, cfg, [], ["state"]
+    )
+    carry = (params, init_opt_states(params))
+
+    def _block_step(carry, batch, key, update_target):
+        del update_target
+        params, opt_states = carry
+        params, opt_states, metrics = train_step(params, opt_states, batch, key)
+        return (params, opt_states), metrics
+
+    block = make_train_block(_block_step, 1, 1)
+    batch = sequence_batch(
+        {"state": obs_space["state"].shape},
+        act_dim=int(sum(actions_dim)),
+        T=int(cfg.algo.per_rank_sequence_length),
+        B=int(cfg.algo.per_rank_batch_size),
+    )
+    return [
+        AuditEntry(
+            name="p2e_dv1/train_block",
+            fn=block,
+            args=(carry, (batch,), jax.random.PRNGKey(0), 0),
+            covers=("p2e_dv1_exploration",),
+            precision=str(cfg.mesh.precision),
+        )
+    ]

@@ -445,6 +445,61 @@ def main(ctx, cfg) -> None:
         logger.close()
 
 
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): AOT-lower the shared
+    ``PPOTrainFns.train_fn`` — the jitted update of BOTH the coupled and decoupled
+    entry points — at tiny synthetic shapes, through the exact builder the
+    training loops use."""
+    from sheeprl_tpu.analysis.ir.synth import (
+        compose_tiny,
+        discrete_act_space,
+        tiny_ctx,
+        vector_space,
+        zeros,
+    )
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+
+    cfg = compose_tiny(
+        [
+            "exp=ppo",
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+            "algo.rollout_steps=4",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.mlp_features_dim=8",
+            "env.num_envs=2",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    obs_space = vector_space()
+    act_space = discrete_act_space()
+    agent, params = build_agent(ctx, act_space, obs_space, cfg)
+    fns = PPOTrainFns(ctx, agent, cfg, ["state"], num_updates=4)
+    opt_state = fns.opt.init(params)
+    n = int(cfg.algo.rollout_steps * cfg.env.num_envs)
+    data = {
+        "state": zeros((n, 5)),
+        "actions": zeros((n, 1)),
+        "logprobs": zeros((n,)),
+        "values": zeros((n,)),
+        "returns": zeros((n,)),
+        "advantages": zeros((n,)),
+    }
+    return [
+        AuditEntry(
+            name="ppo/train_fn",
+            fn=fns.train_fn,
+            args=(params, opt_state, data, jax.random.PRNGKey(0), 0.2, 0.0),
+            covers=("ppo", "ppo_decoupled"),
+            precision=str(cfg.mesh.precision),
+        )
+    ]
+
+
 def replay_update(cfg, dump_dir):
     """Flight-recorder replay builder (``python -m sheeprl_tpu.obs.replay_blackbox``):
     rebuild the PPO jitted update from a blackbox dump's config + statics, restore

@@ -48,36 +48,17 @@ def _onehot_actions(env_act: np.ndarray, actions_dim, is_continuous: bool) -> np
     return np.concatenate(out, -1)
 
 
-@register_algorithm(name="ppo_recurrent")
-def main(ctx, cfg) -> None:
-    rank = ctx.process_index
-    log_dir = get_log_dir(cfg)
-    if ctx.is_global_zero:
-        save_config(cfg, Path(log_dir) / "config.yaml")
-    logger = get_logger(cfg, log_dir)
-    monitor = TrainingMonitor(cfg, log_dir)
+def make_ppo_recurrent_train_fn(ctx, agent, cfg, obs_keys):
+    """Optimizer + the jitted BPTT sequence-minibatch update.
 
-    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
-    obs_space = envs.single_observation_space
-    act_space = envs.single_action_space
-    cnn_keys = list(cfg.algo.cnn_keys.encoder)
-    mlp_keys = list(cfg.algo.mlp_keys.encoder)
-    obs_keys = cnn_keys + mlp_keys
-
-    agent, params = build_agent(ctx, act_space, obs_space, cfg)
-    is_continuous = agent.is_continuous
-    actions_dim = agent.action_dims
-    act_sum = int(sum(actions_dim))
-    hidden = cfg.algo.rnn.lstm.hidden_size
-
+    Module-level (rather than a closure in ``main``) so the IR audit
+    (``sheeprl_tpu.analysis.ir``) can AOT-lower the exact update the entry point
+    jits — the same reason ``make_a2c_train_fn`` moved out for the flight
+    recorder."""
     opt = make_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
-    opt_state = ctx.replicate(opt.init(params))
-
+    is_continuous = agent.is_continuous
+    health = health_enabled(cfg)  # trace-time constant (obs/health.py)
     num_envs = cfg.env.num_envs
-    rollout_steps = cfg.algo.rollout_steps
-    world = jax.process_count()
-    policy_steps_per_iter = int(num_envs * rollout_steps * world)
-    num_updates = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1) if not cfg.dry_run else 1
     num_batches = max(int(cfg.algo.per_rank_num_batches), 1)
     if num_envs % num_batches != 0:
         raise ValueError(
@@ -85,31 +66,6 @@ def main(ctx, cfg) -> None:
             f"({num_batches}): sequence minibatches must be equally sized for static shapes."
         )
     mb_envs = num_envs // num_batches
-
-    rb = ReplayBuffer(
-        rollout_steps,
-        num_envs,
-        obs_keys=obs_keys,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
-    )
-    rb.seed(cfg.seed + rank)
-    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
-    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
-    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
-
-    gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
-    health = health_enabled(cfg)  # trace-time constant (obs/health.py)
-
-    @jax.jit
-    def act_fn(p, obs, prev_actions, is_first, state, key):
-        actor_out, value, new_state = agent.apply(
-            p, obs, prev_actions, is_first, state, method=RecurrentPPOAgent.step
-        )
-        env_act, stored_act, logprob = sample_actions(key, actor_out, is_continuous)
-        return env_act, logprob, value[..., 0], new_state
-
-    gae_fn = jax.jit(lambda r, v, d, nv: gae(r, v, d, nv, rollout_steps, gamma, gae_lambda))
 
     def seq_loss_fn(p, batch, clip_coef, ent_coef):
         actor_out, values = agent.apply(
@@ -163,6 +119,65 @@ def main(ctx, cfg) -> None:
         (p, o_state), metrics = jax.lax.scan(epoch_step, (p, o_state), keys)
         metrics = jax.tree.map(jnp.mean, metrics)
         return p, o_state, maybe_inject_nonfinite(cfg, metrics)
+
+    return opt, train_fn
+
+
+@register_algorithm(name="ppo_recurrent")
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    agent, params = build_agent(ctx, act_space, obs_space, cfg)
+    is_continuous = agent.is_continuous
+    actions_dim = agent.action_dims
+    act_sum = int(sum(actions_dim))
+    hidden = cfg.algo.rnn.lstm.hidden_size
+
+    opt, train_fn = make_ppo_recurrent_train_fn(ctx, agent, cfg, obs_keys)
+    opt_state = ctx.replicate(opt.init(params))
+
+    num_envs = cfg.env.num_envs
+    rollout_steps = cfg.algo.rollout_steps
+    world = jax.process_count()
+    policy_steps_per_iter = int(num_envs * rollout_steps * world)
+    num_updates = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1) if not cfg.dry_run else 1
+    num_batches = max(int(cfg.algo.per_rank_num_batches), 1)
+
+    rb = ReplayBuffer(
+        rollout_steps,
+        num_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+
+    gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
+
+    @jax.jit
+    def act_fn(p, obs, prev_actions, is_first, state, key):
+        actor_out, value, new_state = agent.apply(
+            p, obs, prev_actions, is_first, state, method=RecurrentPPOAgent.step
+        )
+        env_act, stored_act, logprob = sample_actions(key, actor_out, is_continuous)
+        return env_act, logprob, value[..., 0], new_state
+
+    gae_fn = jax.jit(lambda r, v, d, nv: gae(r, v, d, nv, rollout_steps, gamma, gae_lambda))
 
     # analysis.strict: signature guard on the jitted update (drift -> hard error)
     train_fn = strict_guard(cfg, "ppo_recurrent/train_fn", train_fn)
@@ -378,3 +393,60 @@ def test(agent, params, ctx, cfg, log_dir: str, greedy: bool = True) -> float:
         cum_reward += float(reward)
     env.close()
     return cum_reward
+
+
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): the jitted BPTT
+    update at tiny synthetic shapes, through ``make_ppo_recurrent_train_fn``."""
+    from sheeprl_tpu.analysis.ir.synth import (
+        compose_tiny,
+        discrete_act_space,
+        tiny_ctx,
+        vector_space,
+        zeros,
+    )
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+
+    cfg = compose_tiny(
+        [
+            "exp=ppo_recurrent",
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=4",
+            "algo.per_rank_num_batches=2",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.mlp_features_dim=8",
+            "algo.rnn.lstm.hidden_size=8",
+            "env.num_envs=2",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    obs_space = vector_space()
+    act_space = discrete_act_space()
+    agent, params = build_agent(ctx, act_space, obs_space, cfg)
+    opt, train_fn = make_ppo_recurrent_train_fn(ctx, agent, cfg, ["state"])
+    opt_state = opt.init(params)
+    T, N = int(cfg.algo.rollout_steps), int(cfg.env.num_envs)
+    act_sum = int(sum(agent.action_dims))
+    hidden = int(cfg.algo.rnn.lstm.hidden_size)
+    seq_data = {
+        "state": zeros((T, N, 5)),
+        "actions": zeros((T, N, 1)),
+        "prev_actions": zeros((T, N, act_sum)),
+        "is_first": zeros((T, N, 1)),
+        "logprobs": zeros((T, N)),
+        "values": zeros((T, N)),
+        "returns": zeros((T, N)),
+        "advantages": zeros((T, N)),
+    }
+    return [
+        AuditEntry(
+            name="ppo_recurrent/train_fn",
+            fn=train_fn,
+            args=(params, opt_state, seq_data, zeros((N, hidden)), zeros((N, hidden)), jax.random.PRNGKey(0), 0.2, 0.0),
+            covers=("ppo_recurrent",),
+            precision=str(cfg.mesh.precision),
+        )
+    ]

@@ -579,6 +579,77 @@ def main(ctx, cfg) -> None:
         logger.close()
 
 
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): both real SAC
+    dispatch shapes — the host-batch ``[G, B]`` scanned update shared by the
+    coupled and decoupled entry points, and the DONATED fused device-ring block
+    (``buffer.device=True``) whose donation contract IR001 exists to guard."""
+    from sheeprl_tpu.analysis.ir.synth import (
+        box_act_space,
+        compose_tiny,
+        tiny_ctx,
+        transition_ring,
+        vector_space,
+        zeros,
+    )
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+
+    cfg = compose_tiny(
+        [
+            "exp=sac",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=4",
+            "env.num_envs=2",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    obs_space, act_space = vector_space(), box_act_space()
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    precision = str(cfg.mesh.precision)
+    key = jax.random.PRNGKey(0)
+
+    actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
+    opt_state = {
+        "actor": actor_opt.init(params["actor"]),
+        "critic": critic_opt.init(params["critic"]),
+        "alpha": alpha_opt.init(params["log_alpha"]),
+    }
+    G, B = 2, 4
+    batches = {
+        "obs": zeros((G, B, 5)),
+        "next_obs": zeros((G, B, 5)),
+        "actions": zeros((G, B, 2)),
+        "rewards": zeros((G, B, 1)),
+        "dones": zeros((G, B, 1)),
+    }
+    entries = [
+        AuditEntry(
+            name="sac/train_fn",
+            fn=train_fn,
+            args=(params, opt_state, batches, key, jnp.zeros((), jnp.int32)),
+            covers=("sac", "sac_decoupled"),
+            precision=precision,
+        )
+    ]
+
+    ring, filled, rows_added = transition_ring(obs_dim=5, act_dim=2)
+    _, _, _, builder = make_sac_fused_builder(actor, critic, cfg, act_space, ring, B)
+    block = jax.jit(builder(2, True), donate_argnums=(0,))
+    carry = {"params": params, "opt_state": opt_state}
+    entries.append(
+        AuditEntry(
+            name="sac/fused_block",
+            fn=block,
+            args=(carry, ring.arrays, filled, rows_added, key, 0),
+            covers=("sac", "sac_decoupled"),
+            precision=precision,
+        )
+    )
+    return entries
+
+
 def replay_update(cfg, dump_dir):
     """Flight-recorder replay builder: re-execute the dumped SAC gradient block on
     CPU.  Shared by the coupled and decoupled entry points (same

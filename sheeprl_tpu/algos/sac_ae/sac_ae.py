@@ -50,82 +50,18 @@ AGGREGATOR_KEYS = {
 }
 
 
-@register_algorithm(name="sac_ae")
-def main(ctx, cfg) -> None:
-    rank = ctx.process_index
-    log_dir = get_log_dir(cfg)
-    if ctx.is_global_zero:
-        save_config(cfg, Path(log_dir) / "config.yaml")
-    logger = get_logger(cfg, log_dir)
-    monitor = TrainingMonitor(cfg, log_dir)
+def make_sac_ae_train_fn(encoder, decoder, critic, actor, cfg, act_space):
+    """Optimizers + the jitted scanned SAC-AE update over ``[G, B]`` batch blocks
+    (critic every step with encoder gradients, actor/alpha and encoder+decoder
+    reconstruction on their own cadences, EMA targets fused in).
 
-    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
-    obs_space = envs.single_observation_space
-    act_space = envs.single_action_space
-    cnn_keys = list(cfg.algo.cnn_keys.encoder)
-    act_low, act_high = act_space.low, act_space.high
-    rescale = np.isfinite(act_low).all() and np.isfinite(act_high).all()
+    Module-level (rather than a closure in ``main``) so the IR audit
+    (``sheeprl_tpu.analysis.ir``) can AOT-lower the exact update the entry point
+    jits; the fused device-ring block inlines the same function."""
     act_dim = int(np.prod(act_space.shape))
     target_entropy = -act_dim
-
-    encoder, decoder, critic, actor, params = build_agent(ctx, act_space, obs_space, cfg)
-
-    actor_opt = make_optimizer(cfg.algo.actor.optimizer, 0.0)
-    critic_opt = make_optimizer(cfg.algo.critic.optimizer, 0.0)  # covers encoder+critic
-    alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
-    enc_opt = make_optimizer(cfg.algo.encoder.optimizer, 0.0)
-    dec_opt = make_optimizer(cfg.algo.decoder.optimizer, 0.0)
-    opt_state = ctx.replicate(
-        {
-            "actor": actor_opt.init(params["actor"]),
-            "critic": critic_opt.init({"encoder": params["encoder"], "critic": params["critic"]}),
-            "alpha": alpha_opt.init(params["log_alpha"]),
-            "encoder": enc_opt.init(params["encoder"]),
-            "decoder": dec_opt.init(params["decoder"]),
-        }
-    )
-
-    num_envs = cfg.env.num_envs
-    world = jax.process_count()
-    rb = ReplayBuffer(
-        max(int(cfg.buffer.size) // max(num_envs * world, 1), 1),
-        num_envs,
-        obs_keys=cnn_keys,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
-    )
-    rb.seed(cfg.seed + rank)
-
-    # Device-resident replay (buffer.device=True): SAC-AE rows carry BOTH obs and
-    # next-obs pixels, so the host path ships ~2× the Dreamer volume per batch —
-    # the HBM transition ring removes that entirely, and the fused scanned block
-    # samples its indices IN-JIT from the carried PRNG key (one donated dispatch
-    # per gradient block, zero per-step host work).  The ring is not shard_map'd,
-    # so the shared gate runs with allow_dp=False (DP falls back to the host
-    # prefetcher) inside make_transition_ring.
-    h, w = obs_space[cnn_keys[0]].shape[-2:]
-    c_total = sum(int(np.prod(obs_space[k].shape[:-2])) for k in cnn_keys)
-    ring = make_transition_ring(
-        ctx,
-        cfg,
-        rb,
-        {
-            "obs": ((c_total, h, w), jnp.uint8),
-            "next_obs": ((c_total, h, w), jnp.uint8),
-            "actions": ((act_dim,), jnp.float32),
-            "rewards": ((1,), jnp.float32),
-            "dones": ((1,), jnp.float32),
-        },
-    )
-
-    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
-    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
-    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
-    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
-
     gamma = cfg.algo.gamma
     health = health_enabled(cfg)  # trace-time constant (obs/health.py)
-    batch_size = cfg.algo.per_rank_batch_size
     critic_tau = cfg.algo.critic.tau
     encoder_tau = cfg.algo.encoder.tau
     actor_freq = cfg.algo.actor.per_rank_update_freq
@@ -133,20 +69,14 @@ def main(ctx, cfg) -> None:
     target_freq = cfg.algo.critic.per_rank_target_network_update_freq
     l2_lambda = cfg.algo.decoder.l2_lambda
 
+    actor_opt = make_optimizer(cfg.algo.actor.optimizer, 0.0)
+    critic_opt = make_optimizer(cfg.algo.critic.optimizer, 0.0)  # covers encoder+critic
+    alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
+    enc_opt = make_optimizer(cfg.algo.encoder.optimizer, 0.0)
+    dec_opt = make_optimizer(cfg.algo.decoder.optimizer, 0.0)
+
     def _encode(enc_params, img, detach=False):
         return encoder.apply(enc_params, img, detach)
-
-    @jax.jit
-    def act_fn(p, img, key):
-        z = _encode(p["encoder"], img)
-        mean, log_std = actor.apply(p["actor"], z)
-        return actor.dist(mean, log_std).sample(key)
-
-    @jax.jit
-    def greedy_fn(p, img):
-        z = _encode(p["encoder"], img)
-        mean, _ = actor.apply(p["actor"], z)
-        return jnp.tanh(mean)
 
     @jax.jit
     def train_fn(p, o_state, batches, key, step0):
@@ -274,11 +204,101 @@ def main(ctx, cfg) -> None:
             nan_scan(metrics, "sac_ae/train_fn")
         return p, o_state, metrics
 
+    return actor_opt, critic_opt, alpha_opt, enc_opt, dec_opt, train_fn
+
+
+@register_algorithm(name="sac_ae")
+def main(ctx, cfg) -> None:
+    rank = ctx.process_index
+    log_dir = get_log_dir(cfg)
+    if ctx.is_global_zero:
+        save_config(cfg, Path(log_dir) / "config.yaml")
+    logger = get_logger(cfg, log_dir)
+    monitor = TrainingMonitor(cfg, log_dir)
+
+    envs = make_vector_env(cfg, cfg.seed, rank, log_dir if cfg.env.capture_video else None)
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    act_low, act_high = act_space.low, act_space.high
+    rescale = np.isfinite(act_low).all() and np.isfinite(act_high).all()
+    act_dim = int(np.prod(act_space.shape))
+    target_entropy = -act_dim
+
+    encoder, decoder, critic, actor, params = build_agent(ctx, act_space, obs_space, cfg)
+
+    actor_opt, critic_opt, alpha_opt, enc_opt, dec_opt, raw_train_fn = make_sac_ae_train_fn(
+        encoder, decoder, critic, actor, cfg, act_space
+    )
+    opt_state = ctx.replicate(
+        {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init({"encoder": params["encoder"], "critic": params["critic"]}),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+            "encoder": enc_opt.init(params["encoder"]),
+            "decoder": dec_opt.init(params["decoder"]),
+        }
+    )
+
+    num_envs = cfg.env.num_envs
+    world = jax.process_count()
+    rb = ReplayBuffer(
+        max(int(cfg.buffer.size) // max(num_envs * world, 1), 1),
+        num_envs,
+        obs_keys=cnn_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    rb.seed(cfg.seed + rank)
+
+    # Device-resident replay (buffer.device=True): SAC-AE rows carry BOTH obs and
+    # next-obs pixels, so the host path ships ~2× the Dreamer volume per batch —
+    # the HBM transition ring removes that entirely, and the fused scanned block
+    # samples its indices IN-JIT from the carried PRNG key (one donated dispatch
+    # per gradient block, zero per-step host work).  The ring is not shard_map'd,
+    # so the shared gate runs with allow_dp=False (DP falls back to the host
+    # prefetcher) inside make_transition_ring.
+    h, w = obs_space[cnn_keys[0]].shape[-2:]
+    c_total = sum(int(np.prod(obs_space[k].shape[:-2])) for k in cnn_keys)
+    ring = make_transition_ring(
+        ctx,
+        cfg,
+        rb,
+        {
+            "obs": ((c_total, h, w), jnp.uint8),
+            "next_obs": ((c_total, h, w), jnp.uint8),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
+    aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
+    ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+
+    batch_size = cfg.algo.per_rank_batch_size
+
+    def _encode(enc_params, img, detach=False):
+        return encoder.apply(enc_params, img, detach)
+
+    @jax.jit
+    def act_fn(p, img, key):
+        z = _encode(p["encoder"], img)
+        mean, log_std = actor.apply(p["actor"], z)
+        return actor.dist(mean, log_std).sample(key)
+
+    @jax.jit
+    def greedy_fn(p, img):
+        z = _encode(p["encoder"], img)
+        mean, _ = actor.apply(p["actor"], z)
+        return jnp.tanh(mean)
+
     # analysis.strict: signature guard on the jitted update (drift -> hard error).
     # The fused ring block below inlines the RAW update (its outer jit carries the
     # guard semantics via the dispatcher's fixed signature).
-    raw_train_fn = train_fn
-    train_fn = strict_guard(cfg, "sac_ae/train_fn", train_fn)
+    train_fn = strict_guard(cfg, "sac_ae/train_fn", raw_train_fn)
 
     futures = WindowedFutures()
     fused = None
@@ -575,3 +595,58 @@ def test(greedy_fn, params, ctx, cfg, log_dir: str, img_fn) -> float:
         cum_reward += float(reward)
     env.close()
     return cum_reward
+
+
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): the scanned SAC-AE
+    update (critic/actor/decoder cadences + EMA targets) at tiny synthetic pixel
+    shapes, through ``make_sac_ae_train_fn``."""
+    from sheeprl_tpu.analysis.ir.synth import box_act_space, compose_tiny, pixel_space, tiny_ctx, zeros
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+
+    cfg = compose_tiny(
+        [
+            "exp=sac_ae",
+            "env=continuous_dummy",
+            "env.screen_size=32",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.encoder.features_dim=8",
+            "algo.encoder.channels=4",
+            "algo.actor.dense_units=8",
+            "algo.critic.dense_units=8",
+            "algo.per_rank_batch_size=2",
+            "env.num_envs=2",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    obs_space = pixel_space(size=32)
+    act_space = box_act_space()
+    encoder, decoder, critic, actor, params = build_agent(ctx, act_space, obs_space, cfg)
+    actor_opt, critic_opt, alpha_opt, enc_opt, dec_opt, train_fn = make_sac_ae_train_fn(
+        encoder, decoder, critic, actor, cfg, act_space
+    )
+    opt_state = {
+        "actor": actor_opt.init(params["actor"]),
+        "critic": critic_opt.init({"encoder": params["encoder"], "critic": params["critic"]}),
+        "alpha": alpha_opt.init(params["log_alpha"]),
+        "encoder": enc_opt.init(params["encoder"]),
+        "decoder": dec_opt.init(params["decoder"]),
+    }
+    G, B = 2, 2
+    batches = {
+        "obs": zeros((G, B, 3, 32, 32), "uint8"),
+        "next_obs": zeros((G, B, 3, 32, 32), "uint8"),
+        "actions": zeros((G, B, 2)),
+        "rewards": zeros((G, B, 1)),
+        "dones": zeros((G, B, 1)),
+    }
+    return [
+        AuditEntry(
+            name="sac_ae/train_fn",
+            fn=train_fn,
+            args=(params, opt_state, batches, jax.random.PRNGKey(0), jnp.zeros((), jnp.int32)),
+            covers=("sac_ae",),
+            precision=str(cfg.mesh.precision),
+        )
+    ]

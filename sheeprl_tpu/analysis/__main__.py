@@ -29,7 +29,7 @@ def _default_config_dir() -> Optional[Path]:
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sheeprl_tpu.analysis",
-        description="jaxlint: JAX-aware static analysis (rules JL001-JL006) for sheeprl-tpu.",
+        description="jaxlint: JAX-aware static analysis (rules JL001-JL007) for sheeprl-tpu.",
     )
     parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files or directories to lint")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE, help="baseline file of accepted fingerprints")

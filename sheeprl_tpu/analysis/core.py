@@ -1,0 +1,70 @@
+"""Shared static-analysis plumbing: findings, fingerprints and baselines.
+
+Both analysis tiers build on this module:
+
+* the AST tier (``sheeprl_tpu.analysis.engine`` + ``rules/``, the ``jaxlint`` CLI)
+  walks source files;
+* the IR tier (``sheeprl_tpu.analysis.ir``, the ``jaxlint-ir`` CLI) AOT-lowers the
+  jitted updates of every entry point and walks the closed jaxpr / compiled HLO.
+
+A :class:`Finding` is one diagnostic with a stable ``fingerprint`` (rule + path +
+rule-chosen detail token, deliberately *without* the line number so baselines
+survive unrelated edits — for IR findings ``path`` is the audit-entry name and the
+line is 0).  A baseline is a checked-in text file of fingerprints for intentional
+violations, so CI starts green and fails only on new findings.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, List, Sequence, Set
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic.  ``detail`` is a rule-chosen stable token (a config key, a
+    ``function:variable`` pair, an IR artifact name, ...) used for baseline
+    fingerprints instead of the line number, which churns with every unrelated
+    edit."""
+
+    rule: str  # "JL001" / "IR001"
+    path: str  # repo-relative source path (AST) or audit-entry name (IR)
+    line: int  # 1-based; 0 for IR findings (no source line)
+    col: int
+    message: str
+    detail: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule} {self.path} {self.detail}"
+
+    def render(self) -> str:
+        if self.line <= 0:
+            return f"{self.path}: {self.rule} {self.message}"
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+BASELINE_HEADER = "# jaxlint baseline v1 — one fingerprint per line: RULE path detail"
+
+
+def load_baseline(path: os.PathLike) -> Set[str]:
+    p = Path(path)
+    if not p.is_file():
+        return set()
+    out: Set[str] = set()
+    for raw in p.read_text().splitlines():
+        line = raw.strip()
+        if line and not line.startswith("#"):
+            out.add(line)
+    return out
+
+
+def write_baseline(findings: Iterable[Finding], path: os.PathLike) -> None:
+    lines = sorted({f.fingerprint for f in findings})
+    Path(path).write_text(BASELINE_HEADER + "\n" + "\n".join(lines) + "\n")
+
+
+def filter_baseline(findings: Sequence[Finding], baseline: Set[str]) -> List[Finding]:
+    return [f for f in findings if f.fingerprint not in baseline]

@@ -1,16 +1,13 @@
-"""jaxlint engine: findings, suppressions, baselines, and the file walker.
+"""jaxlint engine: the AST tier's suppressions and file walker.
 
-The rules themselves live in :mod:`sheeprl_tpu.analysis.rules`; this module owns the
-machinery every rule shares:
+The rules themselves live in :mod:`sheeprl_tpu.analysis.rules`; the machinery
+shared with the IR tier (:class:`~sheeprl_tpu.analysis.core.Finding`, baseline
+load/write/filter) lives in :mod:`sheeprl_tpu.analysis.core` and is re-exported
+here for backwards compatibility.  This module owns what is AST-specific:
 
-* :class:`Finding` — one diagnostic with a stable ``fingerprint`` (rule + file +
-  rule-chosen detail token, deliberately *without* the line number so baselines
-  survive unrelated edits);
 * suppression comments — ``# jaxlint: disable=JL001`` (or ``disable=JL001,JL004`` /
   ``disable=all``) on the offending line, or on a standalone comment line directly
   above it;
-* the baseline — a checked-in text file of fingerprints for *intentional* violations,
-  so CI starts green and fails only on new findings;
 * :func:`run_lint` — parse every ``.py`` file under the given paths, run the file
   rules per module and the project rules (config drift) once over the whole set.
 """
@@ -25,28 +22,15 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
+from sheeprl_tpu.analysis.core import (  # noqa: F401  (re-exported API)
+    BASELINE_HEADER,
+    Finding,
+    filter_baseline,
+    load_baseline,
+    write_baseline,
+)
+
 _SUPPRESS_MARKER = "jaxlint:"
-
-
-@dataclass(frozen=True)
-class Finding:
-    """One diagnostic.  ``detail`` is a rule-chosen stable token (a config key, a
-    ``function:variable`` pair, ...) used for baseline fingerprints instead of the
-    line number, which churns with every unrelated edit."""
-
-    rule: str  # "JL001"
-    path: str  # repo-relative, posix separators
-    line: int  # 1-based
-    col: int
-    message: str
-    detail: str
-
-    @property
-    def fingerprint(self) -> str:
-        return f"{self.rule} {self.path} {self.detail}"
-
-    def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
 
 
 @dataclass
@@ -124,31 +108,6 @@ def parse_suppressions(source: str) -> Dict[int, Set[str]]:
                 target += 1
         out.setdefault(target, set()).update(rules)
     return out
-
-
-# ------------------------------------------------------------------------ baseline
-BASELINE_HEADER = "# jaxlint baseline v1 — one fingerprint per line: RULE path detail"
-
-
-def load_baseline(path: os.PathLike) -> Set[str]:
-    p = Path(path)
-    if not p.is_file():
-        return set()
-    out: Set[str] = set()
-    for raw in p.read_text().splitlines():
-        line = raw.strip()
-        if line and not line.startswith("#"):
-            out.add(line)
-    return out
-
-
-def write_baseline(findings: Iterable[Finding], path: os.PathLike) -> None:
-    lines = sorted({f.fingerprint for f in findings})
-    Path(path).write_text(BASELINE_HEADER + "\n" + "\n".join(lines) + "\n")
-
-
-def filter_baseline(findings: Sequence[Finding], baseline: Set[str]) -> List[Finding]:
-    return [f for f in findings if f.fingerprint not in baseline]
 
 
 # -------------------------------------------------------------------------- walker

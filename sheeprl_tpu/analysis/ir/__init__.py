@@ -1,0 +1,34 @@
+"""jaxlint-IR: the jaxpr/HLO audit tier (``python -m sheeprl_tpu.analysis.ir``).
+
+The AST tier (``sheeprl_tpu.analysis``) catches source-level hazards; this tier
+audits what XLA actually compiles.  Every entry point's jitted update (and both
+Anakin dispatches) is AOT-lowered through its REAL builder at tiny synthetic
+shapes, then the closed jaxpr and compiled HLO are checked for:
+
+* IR001 donation-not-applied (silent 2x device memory on the donated state),
+* IR002 dtype promotion against the declared precision,
+* IR003 ungated host callbacks inside scan/while bodies,
+* IR004 cross-device collectives / host transfers in single-mesh graphs,
+* IR005 oversize constants folded into the executable,
+* IR006 compile-memory budget drift vs the checked-in ``irbudgets.json``.
+
+See ``howto/static_analysis.md`` ("IR audit") for the workflow.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.analysis.ir.types import AuditEntry  # noqa: F401
+from sheeprl_tpu.analysis.ir.rules import (  # noqa: F401
+    LoweredArtifacts,
+    lower_entry,
+    measured_budget,
+    run_ir_rules,
+)
+from sheeprl_tpu.analysis.ir.budgets import check_budgets, load_budgets, write_budgets  # noqa: F401
+from sheeprl_tpu.analysis.ir.entrypoints import (  # noqa: F401
+    EXPECTED_COVERAGE,
+    REGISTRY,
+    build_entries,
+    coverage_findings,
+    registry_names,
+)

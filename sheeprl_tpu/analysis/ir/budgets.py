@@ -1,0 +1,141 @@
+"""IR006 — compile-memory budgets against the checked-in ``irbudgets.json``.
+
+Same new-violations-only philosophy as ``jaxlint.baseline``: the baseline records
+each audit entry's compile-memory footprint (argument + output + temp bytes from
+``compiled.memory_analysis()``) at tiny audit shapes; CI fails only when an entry
+drifts past the tolerance, appears with no baseline row, or when a baselined
+entry disappears unnoticed.  Regenerate with::
+
+    python -m sheeprl_tpu.analysis.ir --write-budgets
+
+and commit the diff — the review of that diff IS the budget sign-off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from sheeprl_tpu.analysis.core import Finding
+
+DEFAULT_BUDGETS_FILE = "irbudgets.json"
+#: relative drift allowed before IR006 fires; tiny-shape footprints jitter a few
+#: percent across XLA releases, real regressions (an un-donated ring, a doubled
+#: buffer) jump 2x
+DEFAULT_TOLERANCE = 0.25
+#: absolute slack so KB-sized graphs don't trip on layout-padding noise
+DEFAULT_ABS_SLACK = 8 * 1024
+
+
+def load_budgets(path: os.PathLike) -> Optional[Dict]:
+    p = Path(path)
+    if not p.is_file():
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def write_budgets(
+    measurements: Dict[str, Dict[str, int]],
+    path: os.PathLike,
+    tolerance: float = DEFAULT_TOLERANCE,
+    abs_slack: int = DEFAULT_ABS_SLACK,
+) -> None:
+    import jax
+
+    doc = {
+        "meta": {
+            "tolerance": tolerance,
+            "abs_slack_bytes": abs_slack,
+            "jax": jax.__version__,
+            "comment": "compile-memory budgets per audit entry at tiny audit shapes; "
+            "regenerate with: python -m sheeprl_tpu.analysis.ir --write-budgets",
+        },
+        "entries": {name: dict(m) for name, m in sorted(measurements.items())},
+    }
+    Path(path).write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+
+
+def check_budgets(
+    measurements: Dict[str, Dict[str, int]],
+    baseline: Optional[Dict],
+    tolerance: Optional[float] = None,
+) -> List[Finding]:
+    """IR006 findings: per-entry total-bytes drift beyond tolerance, entries with
+    no baseline row, and stale baseline rows for entries that no longer exist."""
+    findings: List[Finding] = []
+    if baseline is None:
+        findings.append(
+            Finding(
+                rule="IR006",
+                path="<budgets>",
+                line=0,
+                col=0,
+                message=(
+                    "no irbudgets.json baseline found: generate one with "
+                    "'python -m sheeprl_tpu.analysis.ir --write-budgets' and commit it"
+                ),
+                detail="missing-baseline",
+            )
+        )
+        return findings
+
+    meta = baseline.get("meta", {})
+    tol = float(tolerance if tolerance is not None else meta.get("tolerance", DEFAULT_TOLERANCE))
+    slack = int(meta.get("abs_slack_bytes", DEFAULT_ABS_SLACK))
+    base_entries = baseline.get("entries", {})
+
+    for name, m in sorted(measurements.items()):
+        base = base_entries.get(name)
+        if base is None:
+            findings.append(
+                Finding(
+                    rule="IR006",
+                    path=name,
+                    line=0,
+                    col=0,
+                    message=(
+                        "new audit entry with no compile-memory budget baseline: "
+                        "regenerate irbudgets.json (--write-budgets) and commit it"
+                    ),
+                    detail="no-budget-row",
+                )
+            )
+            continue
+        measured = int(m.get("total_bytes", 0))
+        budget = int(base.get("total_bytes", 0))
+        allowed = budget * (1.0 + tol) + slack
+        if measured > allowed:
+            findings.append(
+                Finding(
+                    rule="IR006",
+                    path=name,
+                    line=0,
+                    col=0,
+                    message=(
+                        f"compile-memory budget exceeded: {measured} bytes measured vs "
+                        f"{budget} baselined (+{(measured - budget) / max(budget, 1) * 100:.0f}%, "
+                        f"tolerance {tol * 100:.0f}% + {slack} B) — if intentional, "
+                        "regenerate irbudgets.json with --write-budgets"
+                    ),
+                    detail="budget-exceeded",
+                )
+            )
+
+    for name in sorted(set(base_entries) - set(measurements)):
+        findings.append(
+            Finding(
+                rule="IR006",
+                path=name,
+                line=0,
+                col=0,
+                message=(
+                    "stale budget baseline row: this audit entry no longer exists — "
+                    "regenerate irbudgets.json with --write-budgets"
+                ),
+                detail="stale-budget-row",
+            )
+        )
+    return findings

@@ -1,0 +1,110 @@
+"""Registry of auditable programs: every train-step builder's ``lower_for_audit``.
+
+Each value is a ``"module:function"`` hook resolved lazily (importing an algo
+module pulls in jax/flax — the CLI only pays for what it audits).  A hook returns
+a list of :class:`~sheeprl_tpu.analysis.ir.types.AuditEntry`; one builder may
+expose several programs (e.g. SAC's host-batch scan AND its donated fused ring
+block are both real dispatch shapes).
+
+``EXPECTED_COVERAGE`` pins the audit's floor: the union of ``covers`` over all
+entries must include every CLI entry point's jitted update plus both Anakin
+dispatches — the audit fails closed (IR000) if a registry edit drops one.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from sheeprl_tpu.analysis.core import Finding
+from sheeprl_tpu.analysis.ir.types import AuditEntry
+
+#: audit-unit name -> lower_for_audit hook
+REGISTRY: Dict[str, str] = {
+    "ppo": "sheeprl_tpu.algos.ppo.ppo:lower_for_audit",
+    "ppo_recurrent": "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent:lower_for_audit",
+    "a2c": "sheeprl_tpu.algos.a2c.a2c:lower_for_audit",
+    "sac": "sheeprl_tpu.algos.sac.sac:lower_for_audit",
+    "sac_ae": "sheeprl_tpu.algos.sac_ae.sac_ae:lower_for_audit",
+    "droq": "sheeprl_tpu.algos.droq.droq:lower_for_audit",
+    "dreamer_v1": "sheeprl_tpu.algos.dreamer_v1.dreamer_v1:lower_for_audit",
+    "dreamer_v2": "sheeprl_tpu.algos.dreamer_v2.dreamer_v2:lower_for_audit",
+    "dreamer_v3": "sheeprl_tpu.algos.dreamer_v3.dreamer_v3:lower_for_audit",
+    "p2e_dv1": "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_exploration:lower_for_audit",
+    "p2e_dv2": "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_exploration:lower_for_audit",
+    "p2e_dv3": "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration:lower_for_audit",
+    "anakin": "sheeprl_tpu.engine.anakin:lower_for_audit",
+}
+
+#: the 14 CLI entry points whose jitted updates the audit must cover, plus both
+#: Anakin dispatch programs (p2e finetuning rides the dreamer-family
+#: make_train_step builders, so the exploration entries cover it)
+EXPECTED_COVERAGE = frozenset(
+    {
+        "ppo",
+        "ppo_decoupled",
+        "ppo_recurrent",
+        "a2c",
+        "sac",
+        "sac_decoupled",
+        "sac_ae",
+        "droq",
+        "dreamer_v1",
+        "dreamer_v2",
+        "dreamer_v3",
+        "p2e_dv1_exploration",
+        "p2e_dv2_exploration",
+        "p2e_dv3_exploration",
+        "anakin_ppo",
+        "anakin_sac",
+    }
+)
+
+
+def registry_names() -> List[str]:
+    return sorted(REGISTRY)
+
+
+def build_entries(select: Optional[Sequence[str]] = None) -> Iterator[AuditEntry]:
+    """Build (lazily, one registry unit at a time) the audit entries; ``select``
+    filters by registry key.  Unknown keys raise ``ValueError`` eagerly."""
+    if select:
+        unknown = set(select) - set(REGISTRY)
+        if unknown:
+            raise ValueError(f"unknown audit unit(s): {sorted(unknown)}; known: {registry_names()}")
+
+    def _iter() -> Iterator[AuditEntry]:
+        for name in registry_names():
+            if select and name not in select:
+                continue
+            mod_name, _, fn_name = REGISTRY[name].rpartition(":")
+            hook = getattr(importlib.import_module(mod_name), fn_name)
+            for entry in hook():
+                yield entry
+
+    return _iter()
+
+
+def coverage_findings(entries: Sequence[AuditEntry], full_run: bool) -> List[Finding]:
+    """IR000: the audit's own coverage floor (only meaningful on unfiltered runs)."""
+    if not full_run:
+        return []
+    covered = set()
+    for e in entries:
+        covered.update(e.covers)
+    missing = EXPECTED_COVERAGE - covered
+    if not missing:
+        return []
+    return [
+        Finding(
+            rule="IR000",
+            path="<coverage>",
+            line=0,
+            col=0,
+            message=(
+                f"audit coverage dropped below the floor: {sorted(missing)} no longer "
+                "covered by any lower_for_audit hook"
+            ),
+            detail=f"missing:{','.join(sorted(missing))}",
+        )
+    ]

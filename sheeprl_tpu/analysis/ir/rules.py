@@ -1,0 +1,388 @@
+"""IR-level audit rules over the lowered jaxpr and compiled HLO.
+
+| ID    | name                   | catches                                               |
+|-------|------------------------|-------------------------------------------------------|
+| IR001 | donation-not-applied   | ``donate_argnums`` buffers XLA did not alias (the     |
+|       |                        | silent 2x-HBM bug class)                              |
+| IR002 | dtype-promotion        | f64 anywhere; f32 dot/conv under a declared bf16/fp16 |
+|       |                        | compute precision                                     |
+| IR003 | callback-in-scan       | io_callback/debug.callback/pure_callback inside a     |
+|       |                        | scan/while body without the obs/strict gate           |
+| IR004 | collective-in-single-mesh | cross-device collectives (psum/all_gather/...) or  |
+|       |                        | host transfers compiled into a single-mesh graph      |
+| IR005 | oversize-constant      | constants above a size threshold folded into the      |
+|       |                        | executable                                            |
+| IR006 | budget-drift           | compile-memory budgets (arg+out+temp bytes) vs the    |
+|       |                        | checked-in ``irbudgets.json`` baseline                |
+
+Rules IR001-IR005 run on the artifacts of one AOT lowering; IR006 lives in
+:mod:`sheeprl_tpu.analysis.ir.budgets` because it needs the checked-in baseline.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from sheeprl_tpu.analysis.core import Finding
+from sheeprl_tpu.analysis.ir.types import AuditEntry
+
+#: primitives that execute host python from inside the compiled graph
+CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback", "callback"}
+
+#: cross-device collective primitives (jaxpr level; GSPMD-inserted collectives
+#: only exist post-SPMD-partitioning, which a single-mesh graph never runs)
+COLLECTIVE_PRIMS = {
+    "psum",
+    "pmax",
+    "pmin",
+    "pmean",
+    "ppermute",
+    "pshuffle",
+    "all_gather",
+    "all_to_all",
+    "reduce_scatter",
+    "psum_scatter",
+    "collective_permute",
+    "pgather",
+}
+
+#: loop-carrying primitives whose bodies IR003 treats as the hot path
+LOOP_PRIMS = {"scan", "while", "fori_loop"}
+
+
+@dataclass
+class LoweredArtifacts:
+    """Everything one audit entry's AOT pipeline produced."""
+
+    entry: AuditEntry
+    jaxpr: Any  # ClosedJaxpr of the whole program
+    lowered: Any  # jax.stages.Lowered
+    compiled: Any  # jax.stages.Compiled
+    memory: Optional[Any]  # CompiledMemoryStats or None (backend-dependent)
+
+    @property
+    def donated_bytes(self) -> int:
+        return sum(_aval_bytes(a._aval) for a in _flat_args_info(self.lowered) if a.donated)
+
+    @property
+    def donated_count(self) -> int:
+        return sum(1 for a in _flat_args_info(self.lowered) if a.donated)
+
+
+def lower_entry(entry: AuditEntry) -> LoweredArtifacts:
+    """AOT-lower and compile one entry; every IR rule runs off these artifacts."""
+    traced = entry.fn.trace(*entry.args, **entry.kwargs)
+    lowered = traced.lower()
+    compiled = lowered.compile()
+    try:
+        memory = compiled.memory_analysis()
+    except Exception:  # backend without memory stats: IR006 degrades gracefully
+        memory = None
+    return LoweredArtifacts(
+        entry=entry, jaxpr=traced.jaxpr, lowered=lowered, compiled=compiled, memory=memory
+    )
+
+
+# ------------------------------------------------------------------ jaxpr walking
+def _subjaxprs(eqn) -> Iterator[Tuple[Any, Optional[str]]]:
+    """Yield ``(inner_jaxpr, loop_kind)`` for every subjaxpr in an eqn's params;
+    ``loop_kind`` is the eqn's primitive name when the body re-executes (scan /
+    while), else None."""
+    kind = eqn.primitive.name if eqn.primitive.name in LOOP_PRIMS else None
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for v in vals:
+            if hasattr(v, "eqns"):  # open Jaxpr
+                yield v, kind
+            elif hasattr(v, "jaxpr"):  # ClosedJaxpr
+                yield v.jaxpr, kind
+
+
+def iter_eqns(jaxpr, _in_loop: bool = False) -> Iterator[Tuple[Any, bool]]:
+    """Depth-first ``(eqn, inside_loop_body)`` over a (Closed)Jaxpr."""
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in inner.eqns:
+        yield eqn, _in_loop
+        for sub, kind in _subjaxprs(eqn):
+            yield from iter_eqns(sub, _in_loop or kind is not None)
+
+
+def iter_consts(jaxpr) -> Iterator[Any]:
+    """Every constant captured by the program (top level and nested closed
+    jaxprs) — these get folded into the executable."""
+    closed = jaxpr if hasattr(jaxpr, "consts") else None
+    if closed is not None:
+        yield from closed.consts
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in inner.eqns:
+        for val in eqn.params.values():
+            vals = val if isinstance(val, (list, tuple)) else [val]
+            for v in vals:
+                if hasattr(v, "jaxpr"):  # ClosedJaxpr carries its own consts
+                    yield from iter_consts(v)
+                elif hasattr(v, "eqns"):
+                    yield from iter_consts(v)
+
+
+def _flat_args_info(lowered) -> List[Any]:
+    import jax
+
+    return jax.tree.leaves(lowered.args_info, is_leaf=lambda a: hasattr(a, "donated"))
+
+
+def _aval_bytes(aval) -> int:
+    import numpy as np
+
+    try:
+        return int(np.prod(aval.shape)) * aval.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f} MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f} KiB"
+    return f"{n} B"
+
+
+# ------------------------------------------------------------------------- rules
+#: IR001 ignores shortfalls below this many bytes: dispatch programs legitimately
+#: refresh a few scalar counters (e.g. the Anakin per-window episode sums) whose
+#: donated 4-byte buffers XLA then cannot reuse — the bug class is the KB..GB
+#: state (params, optimizer moments, replay rings) held twice, not loose scalars.
+DONATION_SLACK_BYTES = 1024
+
+
+def check_donation(art: LoweredArtifacts, slack_bytes: int = DONATION_SLACK_BYTES) -> List[Finding]:
+    """IR001: every ``donate_argnums`` buffer must be aliased to an output by XLA.
+
+    The aggregate check is byte-exact: ``memory_analysis().alias_size_in_bytes``
+    counts only donation-established input/output aliases, so any shortfall vs
+    the donated argument bytes (beyond ``slack_bytes``) means at least one donated
+    buffer was NOT reused — the program silently holds both copies live (2x HBM
+    on the donated state).  The compiled HLO's ``input_output_alias`` header
+    refines the message with the aliased parameter count when it parses.
+    """
+    entry = art.entry
+    donated = art.donated_bytes
+    if donated == 0:
+        return []
+    aliased = int(getattr(art.memory, "alias_size_in_bytes", 0) or 0) if art.memory else None
+    if aliased is None:
+        return []  # no memory stats on this backend: nothing to compare against
+    if aliased + slack_bytes >= donated:
+        return []
+    n_aliased = len(
+        re.findall(r"\(\d+, \{[^}]*\}, (?:may|must)-alias\)", art.compiled.as_text()[:20000])
+    )
+    return [
+        Finding(
+            rule="IR001",
+            path=entry.name,
+            line=0,
+            col=0,
+            message=(
+                f"donation not applied: {donated - aliased} B of {_fmt_bytes(donated)} "
+                f"donated buffers were NOT aliased by XLA ({n_aliased} parameter(s) "
+                f"aliased of {art.donated_count} donated) — the un-aliased donated "
+                "state is held TWICE in device memory; check that donated inputs "
+                "match an output's shape/dtype and are not read after the call"
+            ),
+            detail="donation-not-applied",
+        )
+    ]
+
+
+def check_dtype_promotion(art: LoweredArtifacts) -> List[Finding]:
+    """IR002: dtype promotion against the declared compute precision — f64
+    anywhere (this repo never declares fp64), and dot/conv ops whose float
+    operands are ALL f32 when the config declares bf16/fp16 compute (the
+    promotion that silently doubles the FLOP cost on chip)."""
+    import jax.numpy as jnp
+
+    entry = art.entry
+    low_precision = any(t in str(entry.precision).lower() for t in ("bf16", "fp16", "16-mixed"))
+    findings: List[Finding] = []
+    seen = set()
+    f64 = jnp.dtype("float64")
+    f32 = jnp.dtype("float32")
+    for eqn, _ in iter_eqns(art.jaxpr):
+        prim = eqn.primitive.name
+        for v in list(eqn.outvars):
+            dtype = getattr(getattr(v, "aval", None), "dtype", None)
+            if dtype == f64 and ("f64", prim) not in seen:
+                seen.add(("f64", prim))
+                findings.append(
+                    Finding(
+                        rule="IR002",
+                        path=entry.name,
+                        line=0,
+                        col=0,
+                        message=f"float64 output of '{prim}' in a graph declared {entry.precision}",
+                        detail=f"f64:{prim}",
+                    )
+                )
+        if low_precision and prim in ("dot_general", "conv_general_dilated"):
+            fdtypes = [
+                getattr(getattr(v, "aval", None), "dtype", None)
+                for v in eqn.invars
+                if getattr(getattr(getattr(v, "aval", None), "dtype", None), "kind", "") == "f"
+            ]
+            if fdtypes and all(d == f32 for d in fdtypes) and ("f32", prim) not in seen:
+                seen.add(("f32", prim))
+                findings.append(
+                    Finding(
+                        rule="IR002",
+                        path=entry.name,
+                        line=0,
+                        col=0,
+                        message=(
+                            f"'{prim}' computes entirely in float32 although the config "
+                            f"declares {entry.precision} compute precision — the input "
+                            "cast to the low-precision dtype never happened"
+                        ),
+                        detail=f"f32:{prim}",
+                    )
+                )
+    return findings
+
+
+def check_callbacks(art: LoweredArtifacts) -> List[Finding]:
+    """IR003: host callbacks inside scan/while bodies.  A callback in the hot
+    loop synchronizes device->host EVERY iteration; only the obs/strict flags may
+    put one there, and the audit build keeps those off (``callbacks_gated``
+    declares an intentional exception)."""
+    entry = art.entry
+    if entry.callbacks_gated:
+        return []
+    findings: List[Finding] = []
+    seen = set()
+    for eqn, in_loop in iter_eqns(art.jaxpr):
+        prim = eqn.primitive.name
+        if in_loop and prim in CALLBACK_PRIMS and prim not in seen:
+            seen.add(prim)
+            findings.append(
+                Finding(
+                    rule="IR003",
+                    path=entry.name,
+                    line=0,
+                    col=0,
+                    message=(
+                        f"host callback '{prim}' compiled inside a scan/while body "
+                        "without the obs/health/strict gate: it synchronizes with the "
+                        "host on every loop iteration"
+                    ),
+                    detail=f"callback:{prim}",
+                )
+            )
+    return findings
+
+
+def check_collectives(art: LoweredArtifacts) -> List[Finding]:
+    """IR004: cross-device collectives (or explicit host transfers) in a graph
+    built for a single mesh: nothing to communicate with, so the op is either
+    dead weight or a latent multi-chip semantics bug."""
+    entry = art.entry
+    if not entry.single_mesh:
+        return []
+    findings: List[Finding] = []
+    seen = set()
+    for eqn, _ in iter_eqns(art.jaxpr):
+        prim = eqn.primitive.name
+        if prim in seen:
+            continue
+        # shard_map lowers psum to the rewrite-capable "psum2" spelling
+        if prim in COLLECTIVE_PRIMS or prim.rstrip("2") in COLLECTIVE_PRIMS:
+            seen.add(prim)
+            findings.append(
+                Finding(
+                    rule="IR004",
+                    path=entry.name,
+                    line=0,
+                    col=0,
+                    message=f"cross-device collective '{prim}' in a single-mesh graph",
+                    detail=f"collective:{prim}",
+                )
+            )
+        elif prim == "device_put":
+            kinds = [str(d) for d in eqn.params.get("devices", [])]
+            if any("host" in k.lower() for k in kinds):
+                seen.add(prim)
+                findings.append(
+                    Finding(
+                        rule="IR004",
+                        path=entry.name,
+                        line=0,
+                        col=0,
+                        message="device-to-host transfer compiled into the graph",
+                        detail="d2h:device_put",
+                    )
+                )
+    return findings
+
+
+def check_constants(art: LoweredArtifacts, max_const_bytes: int = 128 * 1024) -> List[Finding]:
+    """IR005: oversize constants baked into the executable.  Closure-captured
+    arrays become jaxpr consts and ship INSIDE the compiled program: replay
+    rings, weight tables or env data folded this way bloat every executable copy
+    and silently re-upload on each recompile — pass them as arguments instead."""
+    entry = art.entry
+    findings: List[Finding] = []
+    total = 0
+    worst: Optional[int] = None
+    count = 0
+    for const in iter_consts(art.jaxpr):
+        nbytes = int(getattr(const, "nbytes", 0) or 0)
+        total += nbytes
+        if nbytes > max_const_bytes:
+            count += 1
+            worst = max(worst or 0, nbytes)
+    if count:
+        findings.append(
+            Finding(
+                rule="IR005",
+                path=entry.name,
+                line=0,
+                col=0,
+                message=(
+                    f"{count} constant(s) above {_fmt_bytes(max_const_bytes)} baked into "
+                    f"the executable (largest {_fmt_bytes(worst)}, total consts "
+                    f"{_fmt_bytes(total)}): pass large arrays as arguments, not closures"
+                ),
+                detail="oversize-const",
+            )
+        )
+    return findings
+
+
+def run_ir_rules(art: LoweredArtifacts, max_const_bytes: int = 128 * 1024) -> List[Finding]:
+    """IR001-IR005 over one entry's artifacts (IR006 runs in ``budgets``)."""
+    findings: List[Finding] = []
+    findings.extend(check_donation(art))
+    findings.extend(check_dtype_promotion(art))
+    findings.extend(check_callbacks(art))
+    findings.extend(check_collectives(art))
+    findings.extend(check_constants(art, max_const_bytes))
+    return findings
+
+
+def measured_budget(art: LoweredArtifacts) -> Dict[str, int]:
+    """The IR006 measurement for one entry (bytes; zeros when the backend has no
+    memory stats)."""
+    m = art.memory
+    if m is None:
+        return {"argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0, "alias_bytes": 0, "total_bytes": 0}
+    arg = int(m.argument_size_in_bytes)
+    out = int(m.output_size_in_bytes)
+    temp = int(m.temp_size_in_bytes)
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "alias_bytes": int(m.alias_size_in_bytes),
+        "total_bytes": arg + out + temp,
+    }

@@ -1,0 +1,158 @@
+"""Shared helpers for the ``lower_for_audit()`` hooks: tiny configs, synthetic
+spaces and batches.
+
+The audit's contract is "lower the REAL builder with the SMALLEST shapes it
+accepts": every hook composes a config through the same
+:func:`sheeprl_tpu.config.core.compose` path the CLI uses (so config-derived
+trace-time constants — precision, loss reductions, cadences — are the production
+code paths), swaps the env for synthetic ``gymnasium`` spaces, and feeds
+zero-filled batches.  Values never matter to lowering; only shapes, dtypes and
+trace-time constants do.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def tiny_ctx(cfg, seed: int = 0):
+    """A single-device MeshContext at the config's declared precision — the same
+    context shape every training loop builds, pinned to one device so the audit
+    graph is the single-mesh program IR004 checks."""
+    import jax
+
+    from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
+
+    precision = (cfg.get("mesh") or {}).get("precision", "fp32")
+    return MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision=precision, seed=seed)
+
+
+def compose_tiny(overrides: Sequence[str]):
+    """Compose a config for an audit build.  The analysis flags that inject host
+    callbacks (strict-mode ``nan_scan``) or fault injection stay OFF so the
+    audited program is the default production graph — IR003 then treats ANY
+    in-scan callback as a violation.  ``obs.health`` keeps its default (on):
+    the in-jit diagnostics are part of the graph production compiles."""
+    from sheeprl_tpu.config.core import compose
+
+    return compose(
+        overrides=[
+            *overrides,
+            "analysis.strict=False",
+            "analysis.inject_nan=False",
+            "dry_run=True",
+        ]
+    )
+
+
+def vector_space(dim: int = 5, key: str = "state"):
+    import gymnasium as gym
+
+    return gym.spaces.Dict({key: gym.spaces.Box(-20.0, 20.0, (dim,), np.float32)})
+
+
+def pixel_space(channels: int = 3, size: int = 32, key: str = "rgb"):
+    import gymnasium as gym
+
+    return gym.spaces.Dict({key: gym.spaces.Box(0, 255, (channels, size, size), np.uint8)})
+
+
+def box_act_space(dim: int = 2):
+    import gymnasium as gym
+
+    return gym.spaces.Box(-1.0, 1.0, (dim,), np.float32)
+
+
+def discrete_act_space(n: int = 3):
+    import gymnasium as gym
+
+    return gym.spaces.Discrete(n)
+
+
+def zeros(shape: Tuple[int, ...], dtype="float32"):
+    import jax.numpy as jnp
+
+    return jnp.zeros(shape, dtype)
+
+
+def sequence_batch(
+    obs_shapes: Dict[str, Tuple[int, ...]],
+    act_dim: int,
+    T: int = 3,
+    B: int = 2,
+    uint8_keys: Optional[Sequence[str]] = None,
+):
+    """A Dreamer-family ``[T, B, ...]`` sequence batch (the sampled-replay layout
+    every ``make_train_step`` consumes): obs keys + actions/rewards/is_first/
+    terminated/truncated."""
+    uint8_keys = set(uint8_keys or ())
+    batch = {
+        k: zeros((T, B, *shape), "uint8" if k in uint8_keys else "float32")
+        for k, shape in obs_shapes.items()
+    }
+    batch.update(
+        {
+            "actions": zeros((T, B, act_dim)),
+            "rewards": zeros((T, B, 1)),
+            "is_first": zeros((T, B, 1)),
+            "terminated": zeros((T, B, 1)),
+            "truncated": zeros((T, B, 1)),
+        }
+    )
+    return batch
+
+
+def transition_ring(obs_dim: int, act_dim: int, n_envs: int = 2, capacity: int = 16, steps: int = 8):
+    """A tiny filled :class:`~sheeprl_tpu.data.device_buffer.DeviceTransitionRing`
+    for the SAC-family fused-block audits; returns ``(ring, filled, rows_added)``."""
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+
+    ring = DeviceTransitionRing(
+        capacity,
+        n_envs,
+        {
+            "obs": ((obs_dim,), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+    for t in range(steps):
+        ring.add_step(
+            {
+                "obs": np.zeros((1, n_envs, obs_dim), np.float32),
+                "next_obs": np.zeros((1, n_envs, obs_dim), np.float32),
+                "actions": np.zeros((1, n_envs, act_dim), np.float32),
+                "rewards": np.zeros((1, n_envs, 1), np.float32),
+                "dones": np.zeros((1, n_envs, 1), np.float32),
+            },
+            t % capacity,
+            t,
+        )
+    return ring, min(steps, capacity), steps
+
+
+#: shared Dreamer-family shrink: MLP-only, minimal widths.  Compile time is what
+#: bounds the audit (<2 min on one CPU core across every entry point), and the
+#: bug classes IR001-IR006 catch are structural, not width-dependent.
+DREAMER_TINY_OVERRIDES: List[str] = [
+    "algo.cnn_keys.encoder=[]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=3",
+    "algo.horizon=2",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.stochastic_size=4",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+]
+
+#: extra shrink for the discrete-latent variants (DV2/DV3/P2E)
+DREAMER_DISCRETE_OVERRIDES: List[str] = ["algo.world_model.discrete_size=4"]

@@ -1,0 +1,47 @@
+"""Audit-entry contract between the IR tier and the train-step builders.
+
+Each builder module (the 6 shared ``make_train_step``/train-fn builders, the
+Dreamer-family ``make_train_step`` modules and ``engine/anakin.py``) exposes a
+``lower_for_audit()`` hook returning a list of :class:`AuditEntry` — the jitted
+update program built with TINY synthetic shapes, exactly as the entry point's
+training loop builds it (same builder, same config plumbing), so what the audit
+lowers is what production compiles.
+
+This module is deliberately dependency-light (no jax import at module scope) so
+the hooks can import it without cost.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Tuple
+
+
+@dataclass
+class AuditEntry:
+    """One lowerable program: ``fn`` must be a ``jax.jit``-wrapped callable (it
+    exposes ``.lower``/``.trace``); ``args``/``kwargs`` are the synthetic example
+    arguments.
+
+    ``covers`` names the CLI entry points this program is the jitted update of
+    (e.g. the shared ``PPOTrainFns.train_fn`` covers both ``ppo`` and
+    ``ppo_decoupled``) — the audit's coverage report is the union over entries.
+
+    ``precision`` is the config's declared compute precision for this build
+    (``mesh.precision``); IR002 checks dtype promotion against it.
+
+    ``callbacks_gated`` declares that host callbacks inside scan/while bodies are
+    EXPECTED because the build enabled the obs/health/strict flags that emit them;
+    the default audit build keeps those flags off, so any callback found is a
+    violation (IR003).
+    """
+
+    name: str
+    fn: Any
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+    covers: Tuple[str, ...] = ()
+    precision: str = "fp32"
+    callbacks_gated: bool = False
+    single_mesh: bool = True
+    notes: str = ""

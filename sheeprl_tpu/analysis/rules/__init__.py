@@ -9,6 +9,8 @@
 |       |                        | jitted closures over mutable state                  |
 | JL005 | use-after-donation     | reads of a buffer after donate_argnums donated it   |
 | JL006 | config-drift           | cfg keys accessed-but-undefined / defined-but-dead  |
+| JL007 | donated-binding-reuse  | a caller reuses a binding it passed into a function |
+|       |                        | that forwards it to a donated argument              |
 """
 
 from __future__ import annotations
@@ -22,8 +24,17 @@ from sheeprl_tpu.analysis.rules.jl003_host_sync import HostSyncInHotLoop
 from sheeprl_tpu.analysis.rules.jl004_recompile import RecompileHazard
 from sheeprl_tpu.analysis.rules.jl005_donation import UseAfterDonation
 from sheeprl_tpu.analysis.rules.jl006_config_drift import ConfigDrift
+from sheeprl_tpu.analysis.rules.jl007_donated_binding import DonatedBindingReuse
 
-_RULE_CLASSES = [PRNGKeyReuse, TracedControlFlow, HostSyncInHotLoop, RecompileHazard, UseAfterDonation, ConfigDrift]
+_RULE_CLASSES = [
+    PRNGKeyReuse,
+    TracedControlFlow,
+    HostSyncInHotLoop,
+    RecompileHazard,
+    UseAfterDonation,
+    ConfigDrift,
+    DonatedBindingReuse,
+]
 
 
 def default_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
@@ -46,4 +57,5 @@ __all__ = [
     "RecompileHazard",
     "UseAfterDonation",
     "ConfigDrift",
+    "DonatedBindingReuse",
 ]

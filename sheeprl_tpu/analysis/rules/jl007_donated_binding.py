@@ -1,0 +1,151 @@
+"""JL007 — donated binding reused by a CALLER of a donating wrapper.
+
+JL005 flags reads after a direct ``jax.jit(..., donate_argnums=...)`` call; the
+bug class that actually bit this repo hides one call deeper: a plain python
+function (or method) *forwards one of its parameters into a donated argument
+position* — ``FusedRingDispatcher.dispatch`` and the Anakin engine's dispatch
+programs all have this shape — so every caller's binding is donated too, and a
+caller that keeps using its pre-call reference crashes on TPU/GPU only (the
+flight recorder's post-dispatch re-staging exists precisely to dance around
+this).  This rule derives the set of *donating wrappers* (a fixpoint: wrappers
+calling wrappers propagate) and runs the JL005 use-after-donation scope check
+against them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.engine import Finding, Module
+from sheeprl_tpu.analysis.rules.common import (
+    JitIndex,
+    build_jit_index,
+    collect_aliases,
+    iter_scopes,
+    target_names,
+)
+from sheeprl_tpu.analysis.rules.jl005_donation import UseAfterDonation
+
+
+def _donated_positions(call: ast.Call, spec: Dict[str, tuple], params: List[str]) -> Set[str]:
+    """Parameter names of the ENCLOSING function that this call donates."""
+    nums = {n for n in spec.get("donate_argnums", ()) if isinstance(n, int)}
+    names = set(spec.get("donate_argnames", ()))
+    out: Set[str] = set()
+    for i, a in enumerate(call.args):
+        if i in nums and isinstance(a, ast.Name) and a.id in params:
+            out.add(a.id)
+    for kw in call.keywords:
+        if kw.arg in names and isinstance(kw.value, ast.Name) and kw.value.id in params:
+            out.add(kw.value.id)
+    return out
+
+
+def _methods_of_classes(tree: ast.AST) -> Set[str]:
+    """Names of functions defined directly inside a class body (callers reach
+    them through an attribute with the instance bound, shifting positions by 1)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.add(stmt.name)
+    return out
+
+
+def derive_wrapper_index(tree: ast.AST, aliases, base: JitIndex) -> JitIndex:
+    """A :class:`JitIndex` of plain functions/methods that FORWARD a parameter
+    into a donated argument of a known donating callable — from the caller's
+    perspective these functions donate that argument position themselves."""
+    derived = JitIndex()
+    methods = _methods_of_classes(tree)
+
+    def donating_spec(name: str) -> Optional[Dict[str, tuple]]:
+        for idx in (base, derived):
+            if name in idx.names or name in idx.attrs:
+                spec = idx.specs.get(name)
+                if spec and (spec.get("donate_argnums") or spec.get("donate_argnames")):
+                    return spec
+        return None
+
+    def scan_function(scope) -> Optional[Tuple[tuple, tuple]]:
+        params = scope.params()
+        donated: Set[str] = set()
+        rebound: Set[str] = set()
+
+        def handle(node: ast.AST) -> None:
+            # statement-ordered walk: a param rebound before the donating call no
+            # longer aliases the caller's buffer.
+            if isinstance(node, ast.Call):
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    callee = node.func.attr
+                spec = donating_spec(callee) if callee else None
+                if spec is not None:
+                    live = [p for p in params if p not in rebound]
+                    donated.update(_donated_positions(node, spec, live))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                handle(child)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    rebound.update(target_names(t))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                rebound.update(target_names(node.target))
+
+        for stmt in scope.body():
+            handle(stmt)
+        if not donated:
+            return None
+        is_method = scope.name in methods and params and params[0] in ("self", "cls")
+        caller_params = params[1:] if is_method else params
+        nums = tuple(i for i, p in enumerate(caller_params) if p in donated)
+        names = tuple(p for p in caller_params if p in donated)
+        return nums, names
+
+    # fixpoint: wrappers that call wrappers donate transitively
+    for _ in range(3):
+        before = (len(derived.names), len(derived.attrs))
+        for scope in iter_scopes(tree):
+            if not isinstance(scope.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = scope.name
+            if name in base.names or name in base.attrs:
+                continue  # directly jitted: JL005's territory
+            got = scan_function(scope)
+            if got is None:
+                continue
+            nums, names = got
+            spec = {"donate_argnums": nums, "donate_argnames": names}
+            if name in _methods_of_classes(tree):
+                derived.attrs.add(name)
+            else:
+                derived.names.add(name)
+            derived.specs[name] = spec
+        if (len(derived.names), len(derived.attrs)) == before:
+            break
+    return derived
+
+
+class DonatedBindingReuse(UseAfterDonation):
+    id = "JL007"
+    name = "donated-binding-reuse"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        aliases = collect_aliases(module.tree)
+        base = build_jit_index(module.tree, aliases)
+        if not any(
+            spec.get("donate_argnums") or spec.get("donate_argnames") for spec in base.specs.values()
+        ):
+            return []
+        derived = derive_wrapper_index(module.tree, aliases, base)
+        if not derived.names and not derived.attrs:
+            return []
+        findings: List[Finding] = []
+        for scope in iter_scopes(module.tree):
+            findings.extend(self._check_scope(module, scope, aliases, derived))
+        return findings

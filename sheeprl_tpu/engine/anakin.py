@@ -822,3 +822,132 @@ def replay_update(cfg, dump_dir):
         "metrics": host_metrics,
         "new_param_norm": float(jax.device_get(optax.global_norm(carry["params"]))),
     }
+
+
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): BOTH Anakin
+    dispatch programs — the fused PPO iteration (env scan + GAE + the unchanged
+    ``PPOTrainFns.train_fn``) and the fused SAC dispatch (env step + ring write +
+    in-jit-sampled gradient steps) — each as the DONATED jit the engine
+    dispatches, at tiny vmapped-env shapes."""
+    from sheeprl_tpu.algos.ppo.agent import build_agent as build_ppo_agent
+    from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+    from sheeprl_tpu.algos.sac.agent import build_agent as build_sac_agent
+    from sheeprl_tpu.analysis.ir.synth import compose_tiny, tiny_ctx
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+    from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+
+    entries = []
+
+    # ------------------------------------------------------------- PPO dispatch
+    cfg = compose_tiny(
+        [
+            "exp=ppo",
+            "env=jax_cartpole",
+            "algo.anakin=True",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=4",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.mlp_features_dim=8",
+            "env.num_envs=2",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    env, env_params = anakin_env(cfg)
+    obs_key = anakin_mlp_key(cfg)
+    obs_space = gym.spaces.Dict({obs_key: env.observation_space(env_params)})
+    act_space = env.action_space(env_params)
+    agent, params = build_ppo_agent(ctx, act_space, obs_space, cfg)
+    num_envs = int(cfg.env.num_envs)
+    fns = PPOTrainFns(ctx, agent, cfg, [obs_key], num_updates=4)
+    iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key)
+    dispatch = jax.jit(iteration, donate_argnums=(0,))
+    env_state, obs0 = reset_envs(env, env_params, num_envs, jax.random.PRNGKey(1))
+    carry = {
+        "params": params,
+        "opt_state": fns.opt.init(params),
+        "env_state": env_state,
+        "obs": obs0,
+        "key": jax.random.PRNGKey(0),
+        "episode_stats": init_episode_stats(num_envs),
+    }
+    entries.append(
+        AuditEntry(
+            name="anakin/ppo_dispatch",
+            fn=dispatch,
+            args=(carry, 0.2, 0.0),
+            covers=("anakin_ppo",),
+            precision=str(cfg.mesh.precision),
+        )
+    )
+
+    # ------------------------------------------------------------- SAC dispatch
+    cfg = compose_tiny(
+        [
+            "exp=sac",
+            "env=jax_pendulum",
+            "algo.anakin=True",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=4",
+            "algo.replay_ratio=1",
+            "env.num_envs=2",
+            "buffer.size=64",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    env, env_params = anakin_env(cfg)
+    mlp_key = anakin_mlp_key(cfg)
+    obs_space_box = env.observation_space(env_params)
+    act_space = env.action_space(env_params)
+    obs_space = gym.spaces.Dict({mlp_key: obs_space_box})
+    actor, critic, params = build_sac_agent(ctx, act_space, obs_space, cfg)
+    params = jax.tree.map(jnp.copy, params)  # donation safety (critic_target aliases)
+    num_envs = int(cfg.env.num_envs)
+    obs_dim = int(np.prod(obs_space_box.shape))
+    act_dim = int(np.prod(act_space.shape))
+    capacity = max(int(cfg.buffer.size) // max(num_envs, 1), 1)
+    ring = DeviceTransitionRing(
+        capacity,
+        num_envs,
+        {
+            "obs": ((obs_dim,), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+    actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
+        env, env_params, actor, critic, cfg, act_space, ring, int(cfg.algo.per_rank_batch_size)
+    )
+    env_state, obs0 = reset_envs(env, env_params, num_envs, jax.random.PRNGKey(1))
+    carry = {
+        "params": params,
+        "opt_state": {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        },
+        "env_state": env_state,
+        "obs": obs0,
+        "ring": ring.arrays,
+        "rows_added": jnp.zeros((), jnp.int32),
+        "gstep": jnp.zeros((), jnp.int32),
+        "key": jax.random.PRNGKey(0),
+        "episode_stats": init_episode_stats(num_envs),
+    }
+    dispatch = jax.jit(builder(2, 1, True), donate_argnums=(0,))
+    entries.append(
+        AuditEntry(
+            name="anakin/sac_dispatch",
+            fn=dispatch,
+            args=(carry,),
+            covers=("anakin_sac",),
+            precision=str(cfg.mesh.precision),
+        )
+    )
+    return entries

@@ -2,6 +2,8 @@
 
 from pathlib import Path
 
+import pytest
+
 from sheeprl_tpu.analysis.engine import load_baseline, run_lint
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
@@ -31,3 +33,34 @@ def test_cli_module_green_against_baseline():
 
     rc = main([str(PACKAGE), "--baseline", str(BASELINE), "--root", str(REPO_ROOT), "-q"])
     assert rc == 0
+
+
+# ------------------------------------------------------------------- IR audit
+def test_ir_audit_one_real_entry_green_against_committed_budgets(monkeypatch):
+    """Tier-1 slice of the CI ir-audit job: ONE cheap real entry point lowers,
+    compiles, passes IR001-IR005 and matches the checked-in irbudgets.json."""
+    import os
+
+    from sheeprl_tpu.analysis.ir.__main__ import main as ir_main
+
+    monkeypatch.chdir(REPO_ROOT)
+    assert os.path.isfile("irbudgets.json"), "irbudgets.json must be committed"
+    assert ir_main(["--entry", "ppo", "-q"]) == 0
+
+
+@pytest.mark.slow
+def test_ir_audit_full_registry_covers_all_entry_points(monkeypatch):
+    """The whole registry audits green over HEAD and covers the 14 entry points
+    + both Anakin dispatches (the CI ir-audit job's in-repo twin)."""
+    from sheeprl_tpu.analysis.ir import EXPECTED_COVERAGE, build_entries
+    from sheeprl_tpu.analysis.ir.__main__ import main as ir_main
+
+    covered = set()
+    for entry in build_entries():
+        covered.update(entry.covers)
+    assert EXPECTED_COVERAGE <= covered, sorted(EXPECTED_COVERAGE - covered)
+    # the 14 entry points + 2 anakin dispatches (p2e finetuning rides the
+    # dreamer-family builders on top)
+    assert len(EXPECTED_COVERAGE) == 16
+    monkeypatch.chdir(REPO_ROOT)
+    assert ir_main(["-q"]) == 0

@@ -396,3 +396,75 @@ def test_jl006_local_alias_resolution(tmp_path, config_tree):
         """,
     )
     assert "undefined:algo.optimizer.typo_key" in {f.detail for f in findings}
+
+
+# ---------------------------------------------------------------------- JL007
+def test_jl007_positive_caller_reuse_through_wrapper(lint):
+    findings = lint(
+        """
+        import jax
+
+        block = jax.jit(lambda c: c, donate_argnums=(0,))
+
+        def run(carry):
+            return block(carry)
+
+        def loop(carry):
+            out = run(carry)
+            print(carry)  # the wrapper donated it
+            return out
+        """,
+        select=["JL007"],
+    )
+    assert rule_ids(findings) == ["JL007"]
+    assert "carry" in findings[0].message
+
+
+def test_jl007_positive_method_wrapper_shifts_self(lint):
+    findings = lint(
+        """
+        import jax
+
+        class Dispatcher:
+            def __init__(self):
+                self._block = jax.jit(lambda c: c, donate_argnums=(0,))
+
+            def dispatch(self, carry, n):
+                block = jax.jit(lambda c: c, donate_argnums=(0,))
+                return block(carry)
+
+        def loop(d, carry):
+            new = d.dispatch(carry, 3)
+            return carry  # donated through the method's first real argument
+        """,
+        select=["JL007"],
+    )
+    assert rule_ids(findings) == ["JL007"]
+
+
+def test_jl007_negative_rebound_and_copied(lint):
+    findings = lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        block = jax.jit(lambda c: c, donate_argnums=(0,))
+
+        def run(carry):
+            return block(carry)
+
+        def good_loop(carry):
+            carry = run(carry)  # rebound: the new buffer is valid
+            return carry
+
+        def defensive(carry):
+            carry = jax.tree.map(jnp.copy, carry)
+            return block(carry)
+
+        def caller(carry):
+            out = defensive(carry)
+            return carry  # defensive copied before donating: caller binding safe
+        """,
+        select=["JL007"],
+    )
+    assert findings == []

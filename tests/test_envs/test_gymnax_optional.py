@@ -1,0 +1,58 @@
+"""The gymnax adapter's optional-dependency path: ``env.jax.env_id=gymnax:<Env>``
+must fail with a clear ACTIONABLE message when gymnax is absent — not a bare
+ImportError traceback from deep inside the adapter."""
+
+import builtins
+import sys
+
+import pytest
+
+from sheeprl_tpu.envs.jax import make_jax_env
+
+
+@pytest.fixture()
+def without_gymnax(monkeypatch):
+    """Force the no-gymnax environment regardless of what the container has."""
+    monkeypatch.delitem(sys.modules, "gymnax", raising=False)
+    real_import = builtins.__import__
+
+    def _import(name, *args, **kwargs):
+        if name == "gymnax" or name.startswith("gymnax."):
+            raise ImportError(f"No module named {name!r}")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", _import)
+
+
+def test_gymnax_env_id_raises_actionable_error(without_gymnax):
+    with pytest.raises(ImportError) as exc_info:
+        make_jax_env("gymnax:CartPole-v1")
+    msg = str(exc_info.value)
+    # actionable: names the env id, the missing package, the fix, and the
+    # in-tree alternatives that need no extra install
+    assert "gymnax:CartPole-v1" in msg
+    assert "pip install gymnax" in msg
+    assert "cartpole" in msg and "pendulum" in msg
+
+
+def test_gymnax_error_reaches_anakin_entry_gate(without_gymnax):
+    """The Anakin engine's env builder surfaces the same actionable message (the
+    config path a user actually hits: env.jax.env_id=gymnax:<Env>)."""
+    from sheeprl_tpu.config.core import DotDict
+    from sheeprl_tpu.engine.anakin import anakin_env
+
+    cfg = DotDict.wrap(
+        {"env": {"id": "x", "jax": {"enabled": True, "env_id": "gymnax:CartPole-v1"}}}
+    )
+    with pytest.raises(ImportError, match="pip install gymnax"):
+        anakin_env(cfg)
+
+
+def test_in_tree_jax_envs_never_touch_gymnax(without_gymnax):
+    env = make_jax_env("jax_cartpole")
+    assert env.default_params() is not None
+
+
+def test_unknown_jax_env_id_lists_options():
+    with pytest.raises(ValueError, match="gymnax:<EnvName>"):
+        make_jax_env("not_a_real_env")

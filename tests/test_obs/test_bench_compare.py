@@ -55,3 +55,31 @@ def test_disjoint_metric_sets_reported(tmp_path):
     assert report["only_in_base"] == ["old_metric"]
     assert report["only_in_new"] == ["new_metric"]
     assert report["rows"] == [] and report["regressions"] == []
+
+
+def test_dropped_metric_warns_loudly_and_fails_strict(tmp_path, capsys):
+    """A metric present in the baseline but absent from the latest report used to
+    read as a silent pass — it must be listed loudly and fail --strict."""
+    base = _report(tmp_path, "BENCH_a.json", {"sps": (100.0, "grad_steps/s"), "gone": (5.0, "x/s")})
+    new = _report(tmp_path, "BENCH_b.json", {"sps": (101.0, "grad_steps/s")})
+
+    report = bench_compare.compare(base, new, threshold=0.10)
+    assert report["dropped_metrics"] == ["gone"]
+    assert report["regressions"] == []
+
+    table = bench_compare.format_table(report)
+    assert "WARNING" in table and "DROPPED: gone" in table
+
+    rc = bench_compare.main([base, new, "--strict"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "dropped metric(s): gone" in captured.err
+
+    # non-strict: loud but non-fatal (CI's continue-on-error contract)
+    assert bench_compare.main([base, new]) == 0
+
+
+def test_no_dropped_metrics_strict_stays_green(tmp_path):
+    base = _report(tmp_path, "BENCH_a.json", {"sps": (100.0, "grad_steps/s")})
+    new = _report(tmp_path, "BENCH_b.json", {"sps": (102.0, "grad_steps/s"), "extra": (1.0, "x")})
+    assert bench_compare.main([base, new, "--strict"]) == 0
