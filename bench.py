@@ -246,8 +246,12 @@ def bench_droq_utd20() -> dict:
 
 def bench_anakin() -> list:
     """Anakin fused-scan rows (``benchmarks/anakin_bench.py``): on-device jax
-    CartPole env-steps/s vs the host ``SyncVectorEnv`` path, plus the fused PPO
-    collect+update grad-steps/s.  Set ``BENCH_ANAKIN=0`` to skip."""
+    CartPole env-steps/s vs the host ``SyncVectorEnv`` path, the fused PPO
+    collect+update grad-steps/s, the K-member POPULATION dispatch
+    (``anakin_population_steps_per_sec`` + per-member efficiency; ISSUE-8) and
+    the persistent-compilation-cache cold-vs-warm row
+    (``anakin_compile_seconds``).  Set ``BENCH_ANAKIN=0`` to skip; member count
+    via ``BENCH_ANAKIN_MEMBERS``, compile row via ``BENCH_ANAKIN_COMPILE=0``."""
     import sys
 
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))

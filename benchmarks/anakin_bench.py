@@ -19,12 +19,27 @@ Three measurements, each a BENCH-style JSON row on stdout (feeds
   ``speedup_vs_raw_gym_saturated`` the conservative lower bound;
 * ``anakin_ppo_grad_steps_per_sec`` — grad-steps/s of the FULL fused PPO
   iteration (collection scan + GAE + the scanned minibatch update, ONE donated
-  dispatch per iteration), with the implied env-steps/s as an extra.
+  dispatch per iteration), with the implied env-steps/s as an extra;
+* ``anakin_population_steps_per_sec`` — env-steps/s of the POPULATION PPO
+  dispatch (ISSUE-8 / ROADMAP item 4): ``--members`` independent members — each
+  with its own params/optimizer/env states/PRNG streams — trained in one
+  donated dispatch via the member axis (``engine/population.py``).
+  ``per_member_efficiency`` is K-member throughput ÷ (K × single-member
+  throughput): 1.0 means K seeds ride for free, 0.5 means K members cost 2×
+  one member — the per-dispatch and per-scan-step overheads amortizing across
+  the population is exactly Podracer's "multiple agents per chip" win;
+* ``anakin_compile_seconds`` — first-dispatch (trace+compile) seconds of the
+  fused PPO program in a FRESH subprocess with a persistent XLA compilation
+  cache (``compile_cache.{enabled,dir}``): the first run compiles cold and
+  fills the cache, the second deserializes — the row's value is the WARM
+  seconds (lower-better; ``cold_seconds``/``speedup`` ride as extras).  This is
+  ROADMAP item 3's fleet cold-start story measured end to end.
 
 Usage::
 
     python benchmarks/anakin_bench.py
     python benchmarks/anakin_bench.py --num-envs 64 --steps 4096 --host-steps 512
+    python benchmarks/anakin_bench.py --members 16 --pop-envs 16
 """
 
 from __future__ import annotations
@@ -182,6 +197,146 @@ def bench_anakin_ppo(num_envs: int, rollout_steps: int, iters: int, seed: int = 
     }
 
 
+def _population_setup(num_envs: int, rollout_steps: int, seed: int):
+    """Tiny-net fused PPO iteration + per-member carry builder (shared by the
+    population bench and the compile probe).  Small shapes on purpose: the
+    population win IS the fixed-overhead amortization, measured where a single
+    member underuses the chip."""
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+    from sheeprl_tpu.engine.anakin import init_episode_stats, make_ppo_anakin_iteration, reset_envs
+
+    cfg = compose(
+        overrides=[
+            "exp=ppo",
+            "env=jax_cartpole",
+            "algo.anakin=True",
+            "algo.mlp_keys.encoder=[state]",
+            f"env.num_envs={num_envs}",
+            f"algo.rollout_steps={rollout_steps}",
+            f"algo.per_rank_batch_size={max(rollout_steps * num_envs // 4, 1)}",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.mlp_features_dim=8",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+        ]
+    )
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=seed)
+    env = make_jax_env("cartpole")
+    env_params = env.default_params()
+    obs_space = gym.spaces.Dict({"state": env.observation_space(env_params)})
+    agent, params = build_agent(ctx, env.action_space(env_params), obs_space, cfg)
+    fns = PPOTrainFns(ctx, agent, cfg, ["state"], 8)
+    iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, "state")
+
+    def member_carry(m: int):
+        p = jax.tree.map(jnp.copy, params)
+        env_state, obs0 = reset_envs(env, env_params, num_envs, jax.random.fold_in(jax.random.PRNGKey(seed), m))
+        return {
+            "params": p,
+            "opt_state": fns.opt.init(p),
+            "env_state": env_state,
+            "obs": obs0,
+            "key": jax.random.fold_in(jax.random.PRNGKey(seed + 1), m),
+            "episode_stats": init_episode_stats(num_envs),
+        }
+
+    return iteration, member_carry
+
+
+def _time_dispatch(dispatch, carry, args, iters: int) -> float:
+    carry, metrics = dispatch(carry, *args)  # warmup/compile
+    jax.device_get(metrics)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry, metrics = dispatch(carry, *args)
+    jax.device_get(metrics)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_anakin_population(
+    members: int, num_envs: int, rollout_steps: int, iters: int, seed: int = 0
+) -> Dict[str, float]:
+    """Env-steps/s of the K-member population PPO dispatch vs K × the
+    single-member rate (per-member efficiency), both over the default
+    bit-exact ``lax.map`` member axis."""
+    from sheeprl_tpu.engine.population import population_transform, stack_members
+
+    iteration, member_carry = _population_setup(num_envs, rollout_steps, seed)
+    steps = rollout_steps * num_envs
+
+    single = jax.jit(iteration, donate_argnums=(0,))
+    t_single = _time_dispatch(single, member_carry(0), (0.2, 0.0), iters)
+
+    stacked = stack_members([member_carry(m) for m in range(members)])
+    pop = jax.jit(population_transform(iteration, vectorize=False, n_args=2), donate_argnums=(0,))
+    coefs = (jnp.full((members,), 0.2, jnp.float32), jnp.zeros((members,), jnp.float32))
+    t_pop = _time_dispatch(pop, stacked, coefs, iters)
+
+    single_sps = steps / t_single
+    pop_sps = members * steps / t_pop
+    return {
+        "pop_steps_per_sec": pop_sps,
+        "single_steps_per_sec": single_sps,
+        "per_member_efficiency": pop_sps / (members * single_sps),
+    }
+
+
+def _compile_probe(num_envs: int, rollout_steps: int, cache_dir: Optional[str]) -> None:
+    """Child-process half of the compile bench: optionally enable the persistent
+    cache, then time the FIRST dispatch (trace + compile + execute) of the fused
+    PPO program and print one JSON line."""
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    iteration, member_carry = _population_setup(num_envs, rollout_steps, seed=0)
+    dispatch = jax.jit(iteration, donate_argnums=(0,))
+    t0 = time.perf_counter()
+    carry, metrics = dispatch(member_carry(0), 0.2, 0.0)
+    jax.device_get(metrics)
+    print(json.dumps({"first_dispatch_seconds": time.perf_counter() - t0}))
+
+
+def bench_compile_cache(num_envs: int, rollout_steps: int) -> Dict[str, float]:
+    """Cold-vs-warm first-dispatch seconds across two fresh subprocesses sharing
+    one persistent XLA compilation cache directory."""
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="anakin_xla_cache_")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "SHEEPRL_TPU_QUIET": "1"}
+    times = []
+    try:
+        for _ in range(2):
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.abspath(__file__),
+                    "--compile-probe",
+                    "--compile-cache-dir", cache_dir,
+                    "--pop-envs", str(num_envs),
+                    "--pop-rollout", str(rollout_steps),
+                ],
+                capture_output=True,
+                text=True,
+                env=env,
+                timeout=600,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(f"compile probe failed: {proc.stderr[-500:]}")
+            row = json.loads(proc.stdout.strip().splitlines()[-1])
+            times.append(float(row["first_dispatch_seconds"]))
+    finally:
+        import shutil
+
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    cold, warm = times
+    return {"cold_seconds": cold, "warm_seconds": warm, "speedup": cold / max(warm, 1e-9)}
+
+
 def main(argv: Optional[List[str]] = None) -> Dict[str, float]:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--num-envs", type=int, default=int(os.environ.get("BENCH_ANAKIN_ENVS", "1024")))
@@ -196,7 +351,25 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, float]:
         default=4,
         help="env count for the 'current training config' host baseline (the env/default.yaml num_envs)",
     )
+    parser.add_argument(
+        "--members", type=int, default=int(os.environ.get("BENCH_ANAKIN_MEMBERS", "16")),
+        help="population size K for the anakin_population_steps_per_sec row",
+    )
+    parser.add_argument("--pop-envs", type=int, default=int(os.environ.get("BENCH_ANAKIN_POP_ENVS", "16")))
+    parser.add_argument("--pop-rollout", type=int, default=32)
+    parser.add_argument("--pop-iters", type=int, default=int(os.environ.get("BENCH_ANAKIN_POP_ITERS", "6")))
+    parser.add_argument("--skip-population", action="store_true", help="skip the population row")
+    parser.add_argument(
+        "--compile-bench", type=int, default=int(os.environ.get("BENCH_ANAKIN_COMPILE", "1")),
+        help="1 = emit the anakin_compile_seconds cold-vs-warm row (2 subprocesses); 0 = skip",
+    )
+    parser.add_argument("--compile-probe", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--compile-cache-dir", default=None, help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
+
+    if args.compile_probe:  # child-process mode of bench_compile_cache
+        _compile_probe(args.pop_envs, args.pop_rollout, args.compile_cache_dir)
+        return {}
 
     host_sps = bench_host_sync_vector(args.host_envs, args.host_steps)
     raw_envs = min(args.num_envs, 64)  # the python loop saturates long before 64
@@ -227,6 +400,36 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, float]:
             "anakin_ppo_env_steps_per_sec": round(ppo["env_steps_per_sec"], 1),
         }
     )
+    if not args.skip_population:
+        pop = bench_anakin_population(args.members, args.pop_envs, args.pop_rollout, args.pop_iters)
+        rows.append(
+            {
+                "metric": "anakin_population_steps_per_sec",
+                "value": round(pop["pop_steps_per_sec"], 1),
+                "unit": (
+                    f"env_steps/s across all members ({args.members} members x {args.pop_envs} envs x "
+                    f"{args.pop_rollout} rollout, fused population PPO dispatch, lax.map member axis, 1 chip)"
+                ),
+                "members": args.members,
+                "single_member_steps_per_sec": round(pop["single_steps_per_sec"], 1),
+                # K-member throughput / (K x single-member): 1.0 = K seeds ride free
+                "per_member_efficiency": round(pop["per_member_efficiency"], 3),
+            }
+        )
+    if args.compile_bench:
+        cc = bench_compile_cache(args.pop_envs, args.pop_rollout)
+        rows.append(
+            {
+                "metric": "anakin_compile_seconds",
+                "value": round(cc["warm_seconds"], 3),
+                "unit": (
+                    "seconds to first fused-PPO dispatch in a fresh process with a WARM persistent "
+                    "XLA compilation cache (compile_cache.enabled; lower is better)"
+                ),
+                "cold_seconds": round(cc["cold_seconds"], 3),
+                "warm_speedup": round(cc["speedup"], 2),
+            }
+        )
     for row in rows:
         print(json.dumps(row))
     return {row["metric"]: row["value"] for row in rows}

@@ -37,8 +37,11 @@ from typing import Dict, List, Optional, Tuple
 _LOWER_BETTER_HINTS = ("ms", "latency", "time", "seconds")
 # Explicit direction pins beat the unit-text heuristic: every anakin_* row
 # (benchmarks/anakin_bench.py) is a throughput — higher is better — regardless
-# of what its unit string mentions.
+# of what its unit string mentions...
 _HIGHER_BETTER_PREFIXES = ("anakin_",)
+# ...EXCEPT the compile-cache wall-clock row, which is a duration: exact-name
+# pins win over the prefix pin.
+_LOWER_BETTER_METRICS = ("anakin_compile_seconds",)
 
 
 def extract_metrics(path: str) -> Dict[str, Tuple[float, str]]:
@@ -77,6 +80,8 @@ def extract_metrics(path: str) -> Dict[str, Tuple[float, str]]:
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
+    if str(metric).lower() in _LOWER_BETTER_METRICS:
+        return True
     if str(metric).lower().startswith(_HIGHER_BETTER_PREFIXES):
         return False
     blob = f"{metric} {unit}".lower()

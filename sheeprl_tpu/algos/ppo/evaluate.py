@@ -29,7 +29,15 @@ def evaluate_ppo(ctx, cfg: Dict[str, Any], ckpt_path: str) -> float:
     state = CheckpointManager.load(ckpt_path, templates={"params": jax.device_get(params)})
     # Anakin runs (algo.anakin=True) checkpoint the whole scan carry; the policy
     # params live inside it (engine/anakin.py).
-    params = ctx.replicate(state["carry"]["params"] if "params" not in state else state["params"])
+    params = state["carry"]["params"] if "params" not in state else state["params"]
+    if "params" not in state:
+        from sheeprl_tpu.engine.population import PopulationSpec, slice_member
+
+        if PopulationSpec.from_cfg(cfg, "ppo").enabled:
+            # population checkpoints carry a leading member axis: evaluate
+            # member 0, the base-seed member (howto/population.md)
+            params = slice_member(params, 0)
+    params = ctx.replicate(params)
     reward = test(agent, params, ctx, cfg, log_dir)
     print(f"Test/cumulative_reward: {reward}")
     return reward

@@ -56,20 +56,43 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import gae, polynomial_decay
 
 
-def make_optimizer(opt_cfg: Dict[str, Any], max_grad_norm: float, lr_schedule=None) -> optax.GradientTransformation:
+def make_optimizer(
+    opt_cfg: Dict[str, Any], max_grad_norm: float, lr_schedule=None, inject_lr: bool = False
+) -> optax.GradientTransformation:
+    """``inject_lr=True`` builds the same optimizer through
+    ``optax.inject_hyperparams`` so the learning rate lives in the OPTIMIZER
+    STATE instead of the update closure — the population engine's
+    vmapped-by-hyperparameter init (``engine/population.py``) then stamps a
+    per-member rate into each member's state while every member runs the
+    identical update program.  Incompatible with a schedule (a swept rate is a
+    per-member constant)."""
     lr = lr_schedule if lr_schedule is not None else opt_cfg.get("lr", 1e-3)
+    if inject_lr and lr_schedule is not None:
+        raise ValueError("inject_lr (population lr sweep) and a lr schedule are mutually exclusive")
     name = opt_cfg.get("name", "adam")
     if name == "adam":
-        opt = optax.adam(lr, eps=opt_cfg.get("eps", 1e-8), b1=opt_cfg.get("betas", [0.9, 0.999])[0])
         wd = opt_cfg.get("weight_decay", 0.0)
-        if wd:
-            # torch.optim.Adam weight_decay is L2-into-gradient, i.e. the decay is
-            # added BEFORE the Adam scaling (unlike decoupled AdamW).
-            opt = optax.chain(optax.add_decayed_weights(wd), opt)
+
+        def base(learning_rate):
+            o = optax.adam(learning_rate, eps=opt_cfg.get("eps", 1e-8), b1=opt_cfg.get("betas", [0.9, 0.999])[0])
+            if wd:
+                # torch.optim.Adam weight_decay is L2-into-gradient, i.e. the decay
+                # is added BEFORE the Adam scaling (unlike decoupled AdamW).
+                o = optax.chain(optax.add_decayed_weights(wd), o)
+            return o
+
     elif name == "adamw":
-        opt = optax.adamw(lr, eps=opt_cfg.get("eps", 1e-8), weight_decay=opt_cfg.get("weight_decay", 0.0))
+
+        def base(learning_rate):
+            return optax.adamw(
+                learning_rate, eps=opt_cfg.get("eps", 1e-8), weight_decay=opt_cfg.get("weight_decay", 0.0)
+            )
+
     elif name == "sgd":
-        opt = optax.sgd(lr, momentum=opt_cfg.get("momentum", 0.0))
+
+        def base(learning_rate):
+            return optax.sgd(learning_rate, momentum=opt_cfg.get("momentum", 0.0))
+
     elif name == "rmsprop_tf":
         # TF-style RMSProp: eps inside the sqrt (reference optim/rmsprop_tf.py:14-156).
         # optax moved the eps placement behind an ``eps_in_sqrt`` kwarg whose default
@@ -84,9 +107,13 @@ def make_optimizer(opt_cfg: Dict[str, Any], max_grad_norm: float, lr_schedule=No
         )
         if "eps_in_sqrt" in inspect.signature(optax.rmsprop).parameters:
             rmsprop_kwargs["eps_in_sqrt"] = True
-        opt = optax.rmsprop(lr, **rmsprop_kwargs)
+
+        def base(learning_rate):
+            return optax.rmsprop(learning_rate, **rmsprop_kwargs)
+
     else:
         raise ValueError(f"Unknown optimizer: {name}")
+    opt = optax.inject_hyperparams(base)(learning_rate=lr) if inject_lr else base(lr)
     if max_grad_norm and max_grad_norm > 0:
         return optax.chain(optax.clip_by_global_norm(max_grad_norm), opt)
     return opt
@@ -95,7 +122,7 @@ def make_optimizer(opt_cfg: Dict[str, Any], max_grad_norm: float, lr_schedule=No
 class PPOTrainFns:
     """Jitted PPO functions shared by the coupled and decoupled entry points."""
 
-    def __init__(self, ctx, agent, cfg, obs_keys, num_updates):
+    def __init__(self, ctx, agent, cfg, obs_keys, num_updates, inject_lr: bool = False):
         if cfg.algo.per_rank_batch_size <= 0:
             raise ValueError("algo.per_rank_batch_size must be positive")
         num_envs = cfg.env.num_envs
@@ -112,13 +139,18 @@ class PPOTrainFns:
         self.grad_steps_per_update = cfg.algo.update_epochs * self.num_minibatches
         self.lr_schedule = None
         if cfg.algo.anneal_lr:
+            if inject_lr:
+                raise ValueError(
+                    "algo.anneal_lr=True cannot combine with a population learning-rate "
+                    "sweep (the swept rate is a per-member constant in the optimizer state)"
+                )
             self.lr_schedule = optax.polynomial_schedule(
                 init_value=cfg.algo.optimizer.lr,
                 end_value=1e-8,
                 power=1.0,
                 transition_steps=num_updates * self.grad_steps_per_update,
             )
-        self.opt = make_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm, self.lr_schedule)
+        self.opt = make_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm, self.lr_schedule, inject_lr=inject_lr)
 
         is_continuous = agent.is_continuous
         batch_sharding = ctx.batch_sharding()

@@ -41,7 +41,7 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio
 
 
-def make_sac_step_fn(actor, critic, cfg, act_space):
+def make_sac_step_fn(actor, critic, cfg, act_space, inject_lr=()):
     """The per-gradient-step SAC update as a pure function, shared by the host-batch
     scan (:func:`make_sac_train_fn`) and the fused device-ring block
     (:func:`make_sac_fused_builder`):
@@ -50,16 +50,26 @@ def make_sac_step_fn(actor, critic, cfg, act_space):
 
     ``gstep`` is the cumulative gradient-step count BEFORE this step (the EMA
     target cadence tests it post-increment, matching the eager reference).
-    Returns the optimizers too — the callers init/restore optimizer state."""
+    Returns the optimizers too — the callers init/restore optimizer state.
+
+    ``inject_lr`` names optimizers (``"actor"`` / ``"critic"`` / ``"alpha"``)
+    whose learning rate should live in the optimizer STATE
+    (``optax.inject_hyperparams``) instead of the update closure — the
+    population engine's per-member learning-rate sweep
+    (``engine/population.py``)."""
     act_dim = int(np.prod(act_space.shape))
     target_entropy = -act_dim
     tau = cfg.algo.tau
     gamma = cfg.algo.gamma
 
     health = health_enabled(cfg)  # trace-time constant (obs/health.py)
-    actor_opt = make_optimizer(cfg.algo.actor.optimizer, cfg.algo.get("max_grad_norm", 0.0))
-    critic_opt = make_optimizer(cfg.algo.critic.optimizer, cfg.algo.get("max_grad_norm", 0.0))
-    alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0)
+    actor_opt = make_optimizer(
+        cfg.algo.actor.optimizer, cfg.algo.get("max_grad_norm", 0.0), inject_lr="actor" in inject_lr
+    )
+    critic_opt = make_optimizer(
+        cfg.algo.critic.optimizer, cfg.algo.get("max_grad_norm", 0.0), inject_lr="critic" in inject_lr
+    )
+    alpha_opt = make_optimizer(cfg.algo.alpha.optimizer, 0.0, inject_lr="alpha" in inject_lr)
 
     def _losses(p, batch, key):
         key_next, key_new = jax.random.split(key)

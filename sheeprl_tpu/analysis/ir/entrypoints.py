@@ -36,8 +36,9 @@ REGISTRY: Dict[str, str] = {
     "anakin": "sheeprl_tpu.engine.anakin:lower_for_audit",
 }
 
-#: the 14 CLI entry points whose jitted updates the audit must cover, plus both
-#: Anakin dispatch programs (p2e finetuning rides the dreamer-family
+#: the 14 CLI entry points whose jitted updates the audit must cover, plus the
+#: four Anakin dispatch programs — plain AND population (``algo.population``)
+#: for each algo family (p2e finetuning rides the dreamer-family
 #: make_train_step builders, so the exploration entries cover it)
 EXPECTED_COVERAGE = frozenset(
     {
@@ -57,6 +58,8 @@ EXPECTED_COVERAGE = frozenset(
         "p2e_dv3_exploration",
         "anakin_ppo",
         "anakin_sac",
+        "anakin_ppo_pop",
+        "anakin_sac_pop",
     }
 )
 

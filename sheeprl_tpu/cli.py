@@ -167,6 +167,33 @@ def run_algorithm(cfg: DotDict) -> None:
         import jax
 
         jax.config.update("jax_default_matmul_precision", str(precision))
+    compile_cache = cfg.get("compile_cache", {}) or {}
+    if compile_cache.get("enabled", False):
+        # Persistent XLA compilation cache (ROADMAP item 3's cold-start story):
+        # every compiled program is written to disk keyed by its HLO, so a
+        # second run — or a fleet cold start — deserializes instead of
+        # recompiling.  The min-compile-time/entry-size floors drop to zero so
+        # even small programs cache: a cold start wants the WHOLE program set
+        # warm, not just the multi-second flagship dispatches.
+        import jax
+
+        cache_dir = str(
+            compile_cache.get("dir")
+            or Path.home() / ".cache" / "sheeprl_tpu" / "xla_cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        try:
+            # The cache initializes lazily on the FIRST compile and then ignores
+            # config updates: if anything in this process already compiled (test
+            # harnesses, back-to-back runs), the dir set above would silently
+            # never take effect — reset so it re-initializes against it.
+            from jax.experimental.compilation_cache import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # pragma: no cover - experimental API surface
+            pass
     maybe_init_distributed(cfg.get("mesh", {}))
     ctx = make_mesh_context(cfg)
 

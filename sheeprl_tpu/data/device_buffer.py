@@ -352,6 +352,22 @@ class DeviceTransitionRing(DeviceReplayMirror):
             st[:, :rows, 0] = np.asarray(stamps[:rows], np.int64)
         self.arrays[STAMP_KEY] = self._device(st)
 
+    def population_arrays(self, size: int) -> Dict[str, jax.Array]:
+        """Fresh ring arrays with a LEADING MEMBER AXIS — ``[size, n_envs, cap,
+        flat]`` zeros per key — for the population Anakin engine
+        (``engine/population.py``): K independent members' replay rings carried
+        through one fused scan.  Built directly at the stacked shape (stacking
+        K copies of ``self.arrays`` would transiently allocate K extra rings).
+        :meth:`make_scan_writer` / :meth:`make_sample_gather` operate on one
+        member's slice, so the engine's member transform (``lax.map`` /
+        ``vmap``) applies them across the axis unchanged."""
+        return {
+            k: self._device(
+                np.zeros((int(size), self.n_envs, self.capacity, self._flat[k]), np.dtype(self.specs[k][1]))
+            )
+            for k in self.arrays
+        }
+
     def make_scan_writer(self):
         """Pure in-scan analogue of :meth:`add_step`, for loops that carry the ring
         arrays THROUGH a fused scan instead of scattering from host (the Anakin

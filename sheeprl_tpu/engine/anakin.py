@@ -145,7 +145,24 @@ class AnakinFutures:
     ``utils.blocks.WindowedFutures``): ``track`` keeps the dispatch's metrics tree
     ON DEVICE, ``drain`` is the window's only blocking fetch — episode sums are
     folded into ``Rewards/rew_avg``/``Game/ep_len_avg`` and every other key feeds
-    the aggregator.  Window wall-clock gives honest env-steps/s + grad-steps/s."""
+    the aggregator.  Window wall-clock gives honest env-steps/s + grad-steps/s.
+
+    Metric leaves may be scalars (plain Anakin) or carry a LEADING MEMBER AXIS
+    (population dispatches, ``engine/population.py``).  Member-axis reductions,
+    per metric (see ``howto/population.md``):
+
+    * the PLAIN key keeps logging — as the cross-member mean — so existing
+      dashboards stay meaningful;
+    * ``Population/<key>/member_{m}`` logs each member's window value,
+      ``Population/<key>/median`` the cross-member median, and
+      ``Population/<key>/best`` the cross-member max (``Rewards/*`` / ``Game/*``
+      / ``Episodes/*``) or min (``Loss/*``);
+    * ``Rewards/rew_avg`` / ``Game/ep_len_avg`` derive per member from that
+      member's episode sums (members with no finished episodes in the window
+      are skipped), then reduce the same way.
+
+    Everything still rides the window's single blocking ``device_get`` — zero
+    extra host syncs per step regardless of the member count."""
 
     def __init__(self):
         self._pending = []
@@ -162,22 +179,45 @@ class AnakinFutures:
 
     def drain(self, aggregator: Optional[MetricAggregator]) -> Dict[str, float]:
         """Fetch every pending dispatch's metrics (one blocking device_get), feed
-        the aggregator and return the window's derived rates/episode means."""
+        the aggregator and return the window's derived rates/episode means plus
+        any ``Population/*`` member reductions."""
+        from sheeprl_tpu.engine.population import population_rows
+
         fetched = jax.device_get(self._pending) if self._pending else []
         self._pending.clear()
-        ret_sum = len_sum = count = 0.0
+        ret_sum = len_sum = count = 0.0  # scalars or [K] member vectors
+        window: Dict[str, list] = {}
         for tree in fetched:
-            ret_sum += float(tree.pop("Episodes/return_sum", 0.0))
-            len_sum += float(tree.pop("Episodes/len_sum", 0.0))
-            count += float(tree.pop("Episodes/count", 0.0))
-            if aggregator is not None:
-                for k, v in tree.items():
-                    aggregator.update(k, float(v))
+            ret_sum = ret_sum + np.asarray(tree.pop("Episodes/return_sum", 0.0), np.float64)
+            len_sum = len_sum + np.asarray(tree.pop("Episodes/len_sum", 0.0), np.float64)
+            count = count + np.asarray(tree.pop("Episodes/count", 0.0), np.float64)
+            for k, v in tree.items():
+                arr = np.asarray(v)
+                if arr.ndim == 0:  # plain Anakin: scalar leaves, historical path
+                    if aggregator is not None:
+                        aggregator.update(k, float(arr))
+                else:  # population: leading member axis
+                    if aggregator is not None:
+                        aggregator.update(k, float(arr.mean()))
+                    window.setdefault(k, []).append(arr)
         elapsed = max(time.perf_counter() - self._window_t0, 1e-9)
         out: Dict[str, float] = {}
-        if count > 0 and aggregator is not None:
-            aggregator.update("Rewards/rew_avg", ret_sum / count)
-            aggregator.update("Game/ep_len_avg", len_sum / count)
+        for k, arrs in window.items():
+            out.update(population_rows(k, np.mean(np.stack(arrs), axis=0)))
+        if np.ndim(count) == 0:
+            if count > 0 and aggregator is not None:
+                aggregator.update("Rewards/rew_avg", ret_sum / count)
+                aggregator.update("Game/ep_len_avg", len_sum / count)
+        else:
+            with np.errstate(divide="ignore", invalid="ignore"):
+                rew = np.where(count > 0, ret_sum / np.maximum(count, 1e-9), np.nan)
+                length = np.where(count > 0, len_sum / np.maximum(count, 1e-9), np.nan)
+            if np.isfinite(rew).any():
+                if aggregator is not None:
+                    aggregator.update("Rewards/rew_avg", float(np.nanmean(rew)))
+                    aggregator.update("Game/ep_len_avg", float(np.nanmean(length)))
+                out.update(population_rows("Rewards/rew_avg", rew))
+                out.update(population_rows("Game/ep_len_avg", length))
         if self._window_env_steps > 0:
             out["Time/sps_env_interaction"] = self._window_env_steps / elapsed
         if self._window_grad_steps > 0:
@@ -300,13 +340,26 @@ def make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key: str, re
 
 def ppo_anakin(ctx, cfg) -> None:
     """The Anakin PPO entry path (``algo.anakin=True``), called by
-    ``sheeprl_tpu.algos.ppo.ppo.main``."""
+    ``sheeprl_tpu.algos.ppo.ppo.main``.  With ``algo.population.size=K`` (or a
+    sweep) every piece of per-run state gains a leading member axis and K
+    independent members train in the same single donated dispatch
+    (``engine/population.py``; howto/population.md)."""
     from sheeprl_tpu.algos.ppo.agent import build_agent
     from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
     from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, test
+    from sheeprl_tpu.engine.population import (
+        PopulationSpec,
+        member_keys,
+        population_transform,
+        set_injected_lr,
+        slice_member,
+        stack_members,
+    )
 
     env, env_params = anakin_env(cfg)
     obs_key = anakin_mlp_key(cfg)
+    pop = PopulationSpec.from_cfg(cfg, "ppo")
+    members = pop.size if pop.enabled else 1
     log_dir = get_log_dir(cfg)
     if ctx.is_global_zero:
         save_config(cfg, Path(log_dir) / "config.yaml")
@@ -323,21 +376,55 @@ def ppo_anakin(ctx, cfg) -> None:
     total_steps = int(cfg.algo.total_steps)
     num_updates = max(total_steps // policy_steps_per_iter, 1) if not cfg.dry_run else 1
 
-    fns = PPOTrainFns(ctx, agent, cfg, [obs_key], num_updates)
-    opt_state = ctx.replicate(fns.opt.init(params))
+    sweeps_lr = pop.enabled and pop.sweeps_lr("optimizer.lr")
+    fns = PPOTrainFns(ctx, agent, cfg, [obs_key], num_updates, inject_lr=sweeps_lr)
     iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key)
-    # The whole iteration is ONE donated jit: env scan + GAE + the update block.
-    dispatch = strict_guard(cfg, "anakin/ppo_dispatch", jax.jit(iteration, donate_argnums=(0,)))
+    # The whole iteration is ONE donated jit: env scan + GAE + the update block —
+    # for a population, lifted over the member axis first (howto/population.md).
+    if pop.enabled:
+        dispatch = strict_guard(
+            cfg,
+            "anakin/ppo_pop_dispatch",
+            jax.jit(population_transform(iteration, pop.vectorize, n_args=2), donate_argnums=(0,)),
+        )
+    else:
+        dispatch = strict_guard(cfg, "anakin/ppo_dispatch", jax.jit(iteration, donate_argnums=(0,)))
 
-    env_state, obs0 = reset_envs(env, env_params, num_envs, ctx.local_rng())
-    carry = {
-        "params": params,
-        "opt_state": opt_state,
-        "env_state": env_state,
-        "obs": obs0,
-        "key": ctx.rng(),
-        "episode_stats": init_episode_stats(num_envs),
-    }
+    if pop.enabled:
+        # Per-member init: member 0 draws exactly what the plain path draws
+        # (population.size=1 is then bit-identical to plain Anakin); members
+        # m > 0 get fresh init draws / folded key streams.
+        member_params = [params] + [build_agent(ctx, act_space, obs_space, cfg)[1] for _ in range(1, members)]
+        lr_values = pop.values("optimizer.lr", cfg.algo.optimizer.lr)
+        member_carries = []
+        reset_keys = member_keys(ctx.local_rng(), members)
+        carry_keys = member_keys(ctx.rng(), members)
+        for m in range(members):
+            opt_m = fns.opt.init(member_params[m])
+            if sweeps_lr:
+                opt_m = set_injected_lr(opt_m, lr_values[m])
+            env_state_m, obs0_m = reset_envs(env, env_params, num_envs, reset_keys[m])
+            member_carries.append(
+                {
+                    "params": member_params[m],
+                    "opt_state": ctx.replicate(opt_m),
+                    "env_state": env_state_m,
+                    "obs": obs0_m,
+                    "key": carry_keys[m],
+                    "episode_stats": init_episode_stats(num_envs),
+                }
+            )
+        carry = stack_members(member_carries)
+    else:
+        env_state, obs0 = reset_envs(env, env_params, num_envs, ctx.local_rng())
+        carry = {
+            "params": params,
+            "opt_state": ctx.replicate(fns.opt.init(params)),
+            "env_state": env_state,
+            "obs": obs0,
+            "key": ctx.rng(),
+            "episode_stats": init_episode_stats(num_envs),
+        }
 
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
@@ -359,29 +446,46 @@ def ppo_anakin(ctx, cfg) -> None:
         last_checkpoint = state.get("last_checkpoint", 0)
 
     grad_steps_per_update = fns.grad_steps_per_update
+    clip0 = pop.values("clip_coef", cfg.algo.clip_coef) if pop.enabled else [float(cfg.algo.clip_coef)]
+    ent0 = pop.values("ent_coef", cfg.algo.ent_coef) if pop.enabled else [float(cfg.algo.ent_coef)]
     for update in range(start_update, num_updates + 1):
         monitor.advance()
-        clip_coef, ent_coef = cfg.algo.clip_coef, cfg.algo.ent_coef
-        if cfg.algo.anneal_clip_coef:
-            clip_coef = polynomial_decay(update, initial=clip_coef, final=0.0, max_decay_steps=num_updates)
+        clip_coef, ent_coef = list(clip0), list(ent0)
+        if cfg.algo.anneal_clip_coef:  # per member, each from its own swept initial value
+            clip_coef = [
+                polynomial_decay(update, initial=c, final=0.0, max_decay_steps=num_updates) for c in clip_coef
+            ]
         if cfg.algo.anneal_ent_coef:
-            ent_coef = polynomial_decay(update, initial=ent_coef, final=0.0, max_decay_steps=num_updates)
+            ent_coef = [
+                polynomial_decay(update, initial=e, final=0.0, max_decay_steps=num_updates) for e in ent_coef
+            ]
+        if pop.enabled:
+            coef_args = (jnp.asarray(clip_coef, jnp.float32), jnp.asarray(ent_coef, jnp.float32))
+            staged_coefs = {"clip_coef": [float(c) for c in clip_coef], "ent_coef": [float(e) for e in ent_coef]}
+        else:
+            coef_args = (float(clip_coef[0]), float(ent_coef[0]))
+            staged_coefs = {"clip_coef": float(clip_coef[0]), "ent_coef": float(ent_coef[0])}
         with timer("Time/train_time"), monitor.phase("dispatch"):
-            carry, metrics = dispatch(carry, float(clip_coef), float(ent_coef))
-        futures.track(metrics, policy_steps_per_iter, grad_steps_per_update)
+            carry, metrics = dispatch(carry, *coef_args)
+        futures.track(metrics, policy_steps_per_iter * members, grad_steps_per_update * members)
         policy_step += policy_steps_per_iter
-        stage_carry(recorder, carry, update=update, clip_coef=float(clip_coef), ent_coef=float(ent_coef))
+        stage_carry(recorder, carry, update=update, **staged_coefs)
 
         if logger is not None and (
             policy_step - last_log >= cfg.metric.log_every or update == num_updates or cfg.dry_run
         ):
             out = futures.drain(aggregator)  # the window's only blocking device sync
             out.update(aggregator.compute())
-            out["Params/lr"] = (
-                float(fns.lr_schedule(update * grad_steps_per_update))
-                if fns.lr_schedule is not None
-                else float(cfg.algo.optimizer.lr)
-            )
+            if not sweeps_lr:
+                out["Params/lr"] = (
+                    float(fns.lr_schedule(update * grad_steps_per_update))
+                    if fns.lr_schedule is not None
+                    else float(cfg.algo.optimizer.lr)
+                )
+            if pop.enabled:  # the sweep table is static — log it with every flush
+                for name, values in pop.sweep.items():
+                    for m, v in enumerate(values):
+                        out[f"Population/Params/{name}/member_{m}"] = float(v)
             monitor.log_metrics(logger, out, policy_step)
             aggregator.reset()
             last_log = policy_step
@@ -407,7 +511,10 @@ def ppo_anakin(ctx, cfg) -> None:
 
     monitor.close()
     if cfg.algo.run_test and ctx.is_global_zero:
-        reward = test(agent, carry["params"], ctx, cfg, log_dir)
+        # population: the greedy test episode runs member 0's policy (the member
+        # continuing the run's base seed stream — see howto/population.md)
+        test_params = slice_member(carry["params"], 0) if pop.enabled else carry["params"]
+        reward = test(agent, test_params, ctx, cfg, log_dir)
         if logger is not None:
             logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
     if logger is not None:
@@ -415,7 +522,7 @@ def ppo_anakin(ctx, cfg) -> None:
 
 
 # -------------------------------------------------------------------------- SAC
-def make_sac_anakin_dispatch(env, env_params, actor, critic, cfg, act_space, ring, batch_size: int):
+def make_sac_anakin_dispatch(env, env_params, actor, critic, cfg, act_space, ring, batch_size: int, inject_lr=()):
     """Builder of fused SAC Anakin dispatch programs: ``builder(steps,
     grad_per_step, train)`` returns the python function for a ``steps``-iteration
     scan where each iteration steps the vmapped envs once, writes the transition
@@ -427,7 +534,10 @@ def make_sac_anakin_dispatch(env, env_params, actor, critic, cfg, act_space, rin
     own step fn."""
     from sheeprl_tpu.algos.sac.sac import make_sac_step_fn
 
-    actor_opt, critic_opt, alpha_opt, step_update = make_sac_step_fn(actor, critic, cfg, act_space)
+    # inject_lr: population lr sweeps carry per-member rates in the opt state.
+    actor_opt, critic_opt, alpha_opt, step_update = make_sac_step_fn(
+        actor, critic, cfg, act_space, inject_lr=inject_lr
+    )
     sample_gather = ring.make_sample_gather(batch_size)
     write_row = ring.make_scan_writer()
     num_envs = ring.n_envs
@@ -535,35 +645,53 @@ def make_sac_anakin_dispatch(env, env_params, actor, critic, cfg, act_space, rin
 class SacAnakinDispatcher:
     """Compile-once cache of the SAC dispatch programs keyed on (steps,
     grad_per_step, train) — the steady state uses exactly one program; the
-    prefill and a tail remainder add at most two more."""
+    prefill and a tail remainder add at most two more.  ``transform`` lifts each
+    program over the population member axis before jitting
+    (``engine/population.py``: ``lax.map`` by default, ``vmap`` when
+    ``algo.population.vectorize=True``)."""
 
-    def __init__(self, builder, cfg):
+    def __init__(self, builder, cfg, transform=None):
         self._builder = builder
         self._cfg = cfg
+        self._transform = transform
         self._programs: dict = {}
 
     def __call__(self, carry, steps: int, grad_per_step: int, train: bool):
         sig = (steps, grad_per_step, train)
         prog = self._programs.get(sig)
         if prog is None:
-            prog = strict_guard(
-                self._cfg,
-                f"anakin/sac_dispatch_{steps}x{grad_per_step}{'t' if train else 'p'}",
-                jax.jit(self._builder(steps, grad_per_step, train), donate_argnums=(0,)),
-            )
+            fn = self._builder(steps, grad_per_step, train)
+            name = f"anakin/sac_dispatch_{steps}x{grad_per_step}{'t' if train else 'p'}"
+            if self._transform is not None:
+                fn = self._transform(fn)
+                name = f"anakin/sac_pop_dispatch_{steps}x{grad_per_step}{'t' if train else 'p'}"
+            prog = strict_guard(self._cfg, name, jax.jit(fn, donate_argnums=(0,)))
             self._programs[sig] = prog
         return prog(carry)
 
 
 def sac_anakin(ctx, cfg) -> None:
     """The Anakin SAC entry path (``algo.anakin=True``), called by
-    ``sheeprl_tpu.algos.sac.sac.main``."""
+    ``sheeprl_tpu.algos.sac.sac.main``.  ``algo.population.size=K`` trains K
+    independent members — each with its own params, optimizer state, env states,
+    replay ring and PRNG streams — in one donated dispatch
+    (``engine/population.py``; howto/population.md)."""
     from sheeprl_tpu.algos.sac.agent import build_agent
     from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, test
     from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+    from sheeprl_tpu.engine.population import (
+        PopulationSpec,
+        member_keys,
+        population_transform,
+        set_injected_lr,
+        slice_member,
+        stack_members,
+    )
 
     env, env_params = anakin_env(cfg)
     mlp_key = anakin_mlp_key(cfg)
+    pop = PopulationSpec.from_cfg(cfg, "sac")
+    members = pop.size if pop.enabled else 1
     replay_ratio = float(cfg.algo.replay_ratio)
     grad_per_step = int(round(replay_ratio))
     if grad_per_step < 1 or abs(replay_ratio - grad_per_step) > 1e-9:
@@ -605,30 +733,66 @@ def sac_anakin(ctx, cfg) -> None:
             "dones": ((1,), jnp.float32),
         },
     )
+    inject = tuple(n for n in ("actor", "critic", "alpha") if f"{n}.optimizer.lr" in pop.sweep)
     actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
-        env, env_params, actor, critic, cfg, act_space, ring, batch_size
+        env, env_params, actor, critic, cfg, act_space, ring, batch_size, inject_lr=inject
     )
-    opt_state = ctx.replicate(
-        {
-            "actor": actor_opt.init(params["actor"]),
-            "critic": critic_opt.init(params["critic"]),
-            "alpha": alpha_opt.init(params["log_alpha"]),
-        }
-    )
-    dispatcher = SacAnakinDispatcher(builder, cfg)
 
-    env_state, obs0 = reset_envs(env, env_params, num_envs, ctx.local_rng())
-    carry = {
-        "params": params,
-        "opt_state": opt_state,
-        "env_state": env_state,
-        "obs": obs0,
-        "ring": ring.arrays,
-        "rows_added": jnp.zeros((), jnp.int32),
-        "gstep": jnp.zeros((), jnp.int32),
-        "key": ctx.rng(),
-        "episode_stats": init_episode_stats(num_envs),
-    }
+    def init_opt_state(p, member=0):
+        o = {
+            "actor": actor_opt.init(p["actor"]),
+            "critic": critic_opt.init(p["critic"]),
+            "alpha": alpha_opt.init(p["log_alpha"]),
+        }
+        for n in inject:  # stamp the member's swept rate into its own state
+            o[n] = set_injected_lr(o[n], pop.sweep[f"{n}.optimizer.lr"][member])
+        return ctx.replicate(o)
+
+    if pop.enabled:
+        dispatcher = SacAnakinDispatcher(
+            builder, cfg, transform=lambda fn: population_transform(fn, pop.vectorize)
+        )
+        # Per-member init: member 0 draws exactly what the plain path draws
+        # (population.size=1 is bit-identical to plain Anakin); m > 0 members
+        # get fresh param inits and folded key streams.
+        member_params = [params] + [
+            jax.tree.map(jnp.copy, build_agent(ctx, act_space, obs_space, cfg)[2]) for _ in range(1, members)
+        ]
+        reset_keys = member_keys(ctx.local_rng(), members)
+        carry_keys = member_keys(ctx.rng(), members)
+        member_carries = []
+        for m in range(members):
+            env_state_m, obs0_m = reset_envs(env, env_params, num_envs, reset_keys[m])
+            member_carries.append(
+                {
+                    "params": member_params[m],
+                    "opt_state": init_opt_state(member_params[m], m),
+                    "env_state": env_state_m,
+                    "obs": obs0_m,
+                    "rows_added": jnp.zeros((), jnp.int32),
+                    "gstep": jnp.zeros((), jnp.int32),
+                    "key": carry_keys[m],
+                    "episode_stats": init_episode_stats(num_envs),
+                }
+            )
+        carry = stack_members(member_carries)
+        # member-axis ring arrays built at the stacked shape directly (stacking
+        # K per-member copies would transiently allocate K extra rings)
+        carry["ring"] = ring.population_arrays(members)
+    else:
+        dispatcher = SacAnakinDispatcher(builder, cfg)
+        env_state, obs0 = reset_envs(env, env_params, num_envs, ctx.local_rng())
+        carry = {
+            "params": params,
+            "opt_state": init_opt_state(params),
+            "env_state": env_state,
+            "obs": obs0,
+            "ring": ring.arrays,
+            "rows_added": jnp.zeros((), jnp.int32),
+            "gstep": jnp.zeros((), jnp.int32),
+            "key": ctx.rng(),
+            "episode_stats": init_episode_stats(num_envs),
+        }
 
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
@@ -672,6 +836,10 @@ def sac_anakin(ctx, cfg) -> None:
             out.update(aggregator.compute())
             if policy_step > 0:
                 out["Params/replay_ratio"] = grad_per_step  # static by construction
+            if pop.enabled:  # the sweep table is static — log it with every flush
+                for name, values in pop.sweep.items():
+                    for m, v in enumerate(values):
+                        out[f"Population/Params/{name}/member_{m}"] = float(v)
             monitor.log_metrics(logger, out, policy_step)
             aggregator.reset()
             last_log = policy_step
@@ -704,7 +872,7 @@ def sac_anakin(ctx, cfg) -> None:
         monitor.advance()
         with timer("Time/env_interaction_time"), monitor.phase("dispatch"):
             carry, metrics = dispatcher(carry, prefill_steps - iter_num, 0, False)
-        futures.track(metrics, (prefill_steps - iter_num) * num_envs, 0)
+        futures.track(metrics, (prefill_steps - iter_num) * num_envs * members, 0)
         policy_step += (prefill_steps - iter_num) * num_envs
         iter_num = prefill_steps
         stage_carry(recorder, carry, iter_num=iter_num)
@@ -714,7 +882,7 @@ def sac_anakin(ctx, cfg) -> None:
         steps = min(steps_per_dispatch, num_iters - iter_num)
         with timer("Time/train_time"), monitor.phase("dispatch"):
             carry, metrics = dispatcher(carry, steps, grad_per_step, True)
-        futures.track(metrics, steps * num_envs, steps * grad_per_step)
+        futures.track(metrics, steps * num_envs * members, steps * grad_per_step * members)
         policy_step += steps * num_envs
         iter_num += steps
         stage_carry(recorder, carry, iter_num=iter_num)
@@ -724,7 +892,9 @@ def sac_anakin(ctx, cfg) -> None:
 
     monitor.close()
     if cfg.algo.run_test and ctx.is_global_zero:
-        reward = test(actor, carry["params"], ctx, cfg, log_dir)
+        # population: the greedy test episode runs member 0's policy
+        test_params = slice_member(carry["params"], 0) if pop.enabled else carry["params"]
+        reward = test(actor, test_params, ctx, cfg, log_dir)
         if logger is not None:
             logger.log_metrics({"Test/cumulative_reward": reward}, policy_step)
     if logger is not None:
@@ -732,11 +902,19 @@ def sac_anakin(ctx, cfg) -> None:
 
 
 # ------------------------------------------------------------------ replay
-def replay_update(cfg, dump_dir):
+def replay_update(cfg, dump_dir, member: Optional[int] = None):
     """Flight-recorder replay builder: an Anakin blackbox stages the carry
     entering the NEXT dispatch (post-dispatch device-side copy — the dispatch
     donates its input), so replay rebuilds the fused program from the dumped
-    config and re-executes that one dispatch on CPU."""
+    config and re-executes that one dispatch on CPU.
+
+    Population dumps (``algo.population``) stage the FULL stacked carry.
+    ``member=None`` replays the whole population dispatch; ``member=m`` slices
+    member ``m``'s carry off the member axis and replays it through the PLAIN
+    single-member program with that member's swept hyperparameters — under the
+    default ``vectorize=False`` mode this is the exact program the member ran
+    (``python -m sheeprl_tpu.obs.replay_blackbox <dir> --member m``)."""
+    from sheeprl_tpu.engine.population import PopulationSpec, population_transform, slice_member
     from sheeprl_tpu.obs import replay_blackbox
     from sheeprl_tpu.parallel.mesh import make_mesh_context
 
@@ -746,9 +924,21 @@ def replay_update(cfg, dump_dir):
     obs_space = gym.spaces.Dict({obs_key: env.observation_space(env_params)})
     act_space = env.action_space(env_params)
     num_envs = int(cfg.env.num_envs)
+    algo_name = str(cfg.algo.name)
+    pop = PopulationSpec.from_cfg(cfg, "ppo" if algo_name.startswith("ppo") else "sac")
+    if member is not None and not pop.enabled:
+        raise ValueError("--member replay needs a population dump (algo.population in the dumped config)")
+    if member is not None and not 0 <= int(member) < pop.size:
+        raise ValueError(f"--member {member} out of range for population size {pop.size}")
+
+    def pop_template(template):
+        """Population dumps stage the stacked carry: stack K structure copies."""
+        if not pop.enabled:
+            return template
+        return jax.tree.map(lambda x: jnp.stack([x] * pop.size), template)
+
     env_state0, obs0 = reset_envs(env, env_params, num_envs, jax.random.PRNGKey(0))
 
-    algo_name = str(cfg.algo.name)
     if algo_name.startswith("ppo"):
         from sheeprl_tpu.algos.ppo.agent import build_agent
         from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
@@ -756,7 +946,9 @@ def replay_update(cfg, dump_dir):
         agent, params0 = build_agent(ctx, act_space, obs_space, cfg)
         raw = replay_blackbox.load_state(dump_dir)
         num_updates = int(raw["statics"].get("num_updates", 1))
-        fns = PPOTrainFns(ctx, agent, cfg, [obs_key], num_updates)
+        fns = PPOTrainFns(
+            ctx, agent, cfg, [obs_key], num_updates, inject_lr=pop.enabled and pop.sweeps_lr("optimizer.lr")
+        )
         template = {
             "params": params0,
             "opt_state": fns.opt.init(params0),
@@ -765,14 +957,24 @@ def replay_update(cfg, dump_dir):
             "key": jax.random.PRNGKey(0),
             "episode_stats": init_episode_stats(num_envs),
         }
-        state = replay_blackbox.load_state(dump_dir, {"carry": jax.device_get(template)})
+        state = replay_blackbox.load_state(dump_dir, {"carry": jax.device_get(pop_template(template))})
         iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key)
         scalars = state.get("scalars", {})
-        carry, metrics = jax.jit(iteration)(
-            ctx.replicate(state["carry"]),
-            float(scalars.get("clip_coef", cfg.algo.clip_coef)),
-            float(scalars.get("ent_coef", cfg.algo.ent_coef)),
-        )
+        clip = scalars.get("clip_coef", cfg.algo.clip_coef)
+        ent = scalars.get("ent_coef", cfg.algo.ent_coef)
+        staged = ctx.replicate(state["carry"])
+        if pop.enabled and member is None:
+            clip = np.broadcast_to(np.asarray(clip, np.float32), (pop.size,))
+            ent = np.broadcast_to(np.asarray(ent, np.float32), (pop.size,))
+            carry, metrics = jax.jit(population_transform(iteration, pop.vectorize, n_args=2))(
+                staged, jnp.asarray(clip), jnp.asarray(ent)
+            )
+        else:
+            if member is not None:
+                staged = slice_member(staged, int(member))
+                clip = np.reshape(np.broadcast_to(np.asarray(clip, np.float64), (pop.size,)), -1)[int(member)]
+                ent = np.reshape(np.broadcast_to(np.asarray(ent, np.float64), (pop.size,)), -1)[int(member)]
+            carry, metrics = jax.jit(iteration)(staged, float(clip), float(ent))
     else:
         from sheeprl_tpu.algos.sac.agent import build_agent
         from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
@@ -792,8 +994,10 @@ def replay_update(cfg, dump_dir):
                 "dones": ((1,), jnp.float32),
             },
         )
+        inject = tuple(n for n in ("actor", "critic", "alpha") if f"{n}.optimizer.lr" in pop.sweep)
         actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
-            env, env_params, actor, critic, cfg, act_space, ring, int(cfg.algo.per_rank_batch_size)
+            env, env_params, actor, critic, cfg, act_space, ring, int(cfg.algo.per_rank_batch_size),
+            inject_lr=inject,
         )
         template = {
             "params": params0,
@@ -810,18 +1014,27 @@ def replay_update(cfg, dump_dir):
             "key": jax.random.PRNGKey(0),
             "episode_stats": init_episode_stats(num_envs),
         }
-        state = replay_blackbox.load_state(dump_dir, {"carry": jax.device_get(template)})
+        state = replay_blackbox.load_state(dump_dir, {"carry": jax.device_get(pop_template(template))})
         grad_per_step = int(round(float(cfg.algo.replay_ratio)))
-        dispatch = jax.jit(builder(1, grad_per_step, True))
-        carry, metrics = dispatch(ctx.replicate(state["carry"]))
+        program = builder(1, grad_per_step, True)
+        staged = ctx.replicate(state["carry"])
+        if pop.enabled and member is None:
+            carry, metrics = jax.jit(population_transform(program, pop.vectorize))(staged)
+        else:
+            if member is not None:
+                staged = slice_member(staged, int(member))
+            carry, metrics = jax.jit(program)(staged)
 
     host_metrics = jax.device_get(metrics)
     import optax
 
-    return {
+    out = {
         "metrics": host_metrics,
         "new_param_norm": float(jax.device_get(optax.global_norm(carry["params"]))),
     }
+    if member is not None:
+        out["member"] = int(member)
+    return out
 
 
 def lower_for_audit():
@@ -880,6 +1093,24 @@ def lower_for_audit():
             fn=dispatch,
             args=(carry, 0.2, 0.0),
             covers=("anakin_ppo",),
+            precision=str(cfg.mesh.precision),
+        )
+    )
+
+    # Population variant (K=2, default member-scan mode): the same iteration
+    # lifted over the member axis — audited as its own donated program because
+    # the member axis must thread through every carry consumer without breaking
+    # the donation contract (IR001) or blowing the compile-memory budget (IR006).
+    from sheeprl_tpu.engine.population import population_transform
+
+    pop_carry = jax.tree.map(lambda x: jnp.stack([x, x]), carry)
+    pop_dispatch = jax.jit(population_transform(iteration, vectorize=False, n_args=2), donate_argnums=(0,))
+    entries.append(
+        AuditEntry(
+            name="anakin/ppo_pop_dispatch",
+            fn=pop_dispatch,
+            args=(pop_carry, jnp.full((2,), 0.2, jnp.float32), jnp.zeros((2,), jnp.float32)),
+            covers=("anakin_ppo_pop",),
             precision=str(cfg.mesh.precision),
         )
     )
@@ -947,6 +1178,20 @@ def lower_for_audit():
             fn=dispatch,
             args=(carry,),
             covers=("anakin_sac",),
+            precision=str(cfg.mesh.precision),
+        )
+    )
+
+    # Population variant (K=2): ring arrays + counters + params all gain the
+    # member axis; the fused env-step/ring-write/update program is unchanged.
+    pop_carry = jax.tree.map(lambda x: jnp.stack([x, x]), carry)
+    pop_dispatch = jax.jit(population_transform(builder(2, 1, True), vectorize=False), donate_argnums=(0,))
+    entries.append(
+        AuditEntry(
+            name="anakin/sac_pop_dispatch",
+            fn=pop_dispatch,
+            args=(pop_carry,),
+            covers=("anakin_sac_pop",),
             precision=str(cfg.mesh.precision),
         )
     )

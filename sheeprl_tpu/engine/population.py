@@ -1,0 +1,227 @@
+"""Population Anakin: the member axis for multi-seed / multi-hyperparameter runs.
+
+Podracer (arxiv 2104.06272) trains "multiple independent agents per chip" by
+mapping the whole agent+env loop over a population axis; ROADMAP item 4 names it
+the cheapest scenario-diversity multiplier and the fix for the single-seed
+evidence weakness.  This module is the engine-side machinery: with
+``algo.population.size=K`` the Anakin engine stacks K members' ENTIRE carries —
+env states, agent params, optimizer state, :class:`~sheeprl_tpu.data.
+device_buffer.DeviceTransitionRing` arrays, PRNG keys, episode/health
+accumulators — under one leading member axis and trains all of them in ONE
+donated jitted dispatch, for both ``ppo_anakin`` and ``sac_anakin``.
+
+Two member-axis execution modes, one program shape:
+
+* ``vectorize=False`` (default): the member axis runs through ``jax.lax.map`` —
+  a ``lax.scan`` whose body is EXACTLY the single-member program, so every
+  member is bit-identical to the run a standalone dispatch would produce
+  (``tests/test_engine/test_population.py`` pins it member-for-member).  On a
+  host CPU this is also the fastest mode: the per-dispatch and per-scan
+  overheads amortize across members (the ``anakin_population_steps_per_sec``
+  bench records per-member efficiency).
+* ``vectorize=True``: the member axis runs through ``jax.vmap`` — the classic
+  Podracer layout that batches all members' tensor ops into wide kernels for
+  parallel hardware (TPU/GPU).  XLA may fuse the batched ops differently from
+  the unbatched program (observed at ~1e-8 on CPU matvec chains), so this mode
+  trades the bitwise guarantee for utilization; statistically it is the same
+  training run.
+
+``algo.population.sweep`` maps named scalar hyperparameters across members on
+top of the seed axis (``{ent_coef: [0.0, 0.01, ...]}``; list length must equal
+``size``).  Sweepable names per algorithm:
+
+* PPO: ``clip_coef`` / ``ent_coef`` (already traced scalars of the fused
+  iteration — they simply become ``[K]`` vectors) and ``optimizer.lr``;
+* SAC: ``actor.optimizer.lr`` / ``critic.optimizer.lr`` / ``alpha.optimizer.lr``.
+
+Learning rates cannot become traced arguments of the existing update closures
+(optax bakes them into ``opt.update``), so swept learning rates ride the
+*optimizer state*: the optimizer is built with ``optax.inject_hyperparams`` and
+each member's ``opt_state`` carries its own ``learning_rate`` leaf
+(:func:`set_injected_lr`) — the vmapped-by-hyperparameter optimizer init.  The
+update program stays identical across members.
+
+PRNG contract (:func:`member_keys`): member 0 continues the run's base stream
+unchanged — so a population of one reproduces the plain engine bit-for-bit —
+and member ``m > 0`` folds its index into the stream (``fold_in(base, m)``),
+giving every member an independent, reproducible seed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Mapping, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: sweepable hyperparameter names per Anakin algorithm family (see module docs)
+SWEEPABLE = {
+    "ppo": ("clip_coef", "ent_coef", "optimizer.lr"),
+    "sac": ("actor.optimizer.lr", "critic.optimizer.lr", "alpha.optimizer.lr"),
+}
+
+
+def _flatten(prefix: str, node: Any, out: Dict[str, Any]) -> None:
+    if isinstance(node, Mapping):
+        for k, v in node.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+    else:
+        out[prefix] = node
+
+
+@dataclass(frozen=True)
+class PopulationSpec:
+    """Validated ``algo.population`` config: member count, execution mode and the
+    flattened sweep table (``name -> (v_0, ..., v_{K-1})``)."""
+
+    size: int = 1
+    vectorize: bool = False
+    sweep: Dict[str, Tuple[float, ...]] = field(default_factory=dict)
+
+    @property
+    def enabled(self) -> bool:
+        """The engine takes the population path for K > 1 or any sweep (a sweep
+        of length 1 is a valid single-member population)."""
+        return self.size > 1 or bool(self.sweep)
+
+    @classmethod
+    def from_cfg(cls, cfg, algo: str) -> "PopulationSpec":
+        pop = cfg.algo.get("population", {}) or {}
+        size = int(pop.get("size", 1) or 1)
+        if size < 1:
+            raise ValueError(f"algo.population.size must be >= 1; got {size}")
+        vectorize = bool(pop.get("vectorize", False))
+        raw = pop.get("sweep", {}) or {}
+        flat: Dict[str, Any] = {}
+        _flatten("", raw, flat)
+        allowed = SWEEPABLE.get(algo, ())
+        sweep: Dict[str, Tuple[float, ...]] = {}
+        for name, values in flat.items():
+            if name not in allowed:
+                raise ValueError(
+                    f"algo.population.sweep.{name} is not sweepable for {algo!r}; "
+                    f"supported: {list(allowed)}"
+                )
+            if not isinstance(values, (list, tuple)):
+                raise ValueError(
+                    f"algo.population.sweep.{name} must be a per-member list; got {values!r}"
+                )
+            if len(values) != size:
+                raise ValueError(
+                    f"algo.population.sweep.{name} has {len(values)} values but "
+                    f"algo.population.size={size}: one value per member required"
+                )
+            sweep[name] = tuple(float(v) for v in values)
+        return cls(size=size, vectorize=vectorize, sweep=sweep)
+
+    def values(self, name: str, default: float) -> List[float]:
+        """Per-member values for hyperparameter ``name``: the sweep row, or the
+        config default broadcast across members."""
+        if name in self.sweep:
+            return list(self.sweep[name])
+        return [float(default)] * self.size
+
+    def sweeps_lr(self, *names: str) -> bool:
+        return any(n in self.sweep for n in names)
+
+
+def member_keys(base: jax.Array, size: int) -> jax.Array:
+    """``[K, 2]`` per-member PRNG keys.  Member 0 continues the base stream
+    unchanged (``population.size=1`` then reproduces a plain Anakin run
+    bit-for-bit); member m > 0 gets ``fold_in(base, m)``."""
+    keys = [base] + [jax.random.fold_in(base, m) for m in range(1, size)]
+    return jnp.stack(keys)
+
+
+def stack_members(carries: Sequence[Any]) -> Any:
+    """Stack per-member carries under a leading member axis (leaf-wise)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *carries)
+
+
+def slice_member(tree: Any, member: int) -> Any:
+    """Member ``member``'s slice of a population pytree (drops the member axis)."""
+    return jax.tree.map(lambda x: x[member], tree)
+
+
+def population_transform(fn: Callable, vectorize: bool, n_args: int = 0) -> Callable:
+    """Lift a single-member program ``fn(carry, *scalars)`` over a leading member
+    axis on the carry AND every scalar argument (each becomes a ``[K]`` vector).
+
+    ``vectorize=False`` maps members through ``lax.scan`` (``jax.lax.map``): the
+    body jaxpr is the unbatched program, so each member computes bit-identically
+    to a standalone dispatch.  ``vectorize=True`` batches members with
+    ``jax.vmap`` for parallel hardware.  Both shapes are ONE jitted dispatch.
+    """
+    if vectorize:
+        return jax.vmap(fn, in_axes=(0,) * (1 + n_args))
+
+    def mapped(carry, *scalars):
+        return jax.lax.map(lambda xs: fn(*xs), (carry, *scalars))
+
+    return mapped
+
+
+def set_injected_lr(opt_state: Any, lr: float) -> Any:
+    """Rewrite every ``optax.inject_hyperparams`` state's ``learning_rate`` leaf
+    inside ``opt_state`` (recursing through chain tuples/lists only — never into
+    param dicts, whose leaves are arrays, not optimizer states).  This is how a
+    swept learning rate becomes per-member: init the shared injected optimizer
+    once per member, then stamp the member's rate into its own state."""
+    def rewrite(state):
+        # Duck-typed: optax spells the state InjectHyperparamsState or
+        # InjectStatefulHyperparamsState depending on version — both are
+        # NamedTuples with a ``hyperparams`` dict field.
+        if hasattr(state, "_fields") and "hyperparams" in getattr(state, "_fields", ()):
+            hp = dict(state.hyperparams)
+            if "learning_rate" not in hp:
+                raise ValueError("inject_hyperparams state has no learning_rate to sweep")
+            hp["learning_rate"] = jnp.asarray(lr, jnp.asarray(hp["learning_rate"]).dtype)
+            return state._replace(hyperparams=hp)
+        if isinstance(state, tuple):
+            rewritten = tuple(rewrite(s) for s in state)
+            return type(state)(*rewritten) if hasattr(state, "_fields") else rewritten
+        if isinstance(state, list):
+            return [rewrite(s) for s in state]
+        return state
+
+    out = rewrite(opt_state)
+    if all(l1 is l2 for l1, l2 in zip(jax.tree.leaves(out), jax.tree.leaves(opt_state))):
+        raise ValueError(
+            "no inject_hyperparams learning_rate found in the optimizer state: "
+            "build the optimizer with inject_lr=True to sweep its learning rate"
+        )
+    return out
+
+
+# ------------------------------------------------------------------- metrics
+#: key prefixes whose population "best" is the MINIMUM across members; every
+#: other reduced namespace (Rewards/, Game/, Episodes/) takes the maximum.
+#: Health/* and Params/* get member rows + median only (no meaningful "best").
+_BEST_MIN_PREFIXES = ("Loss/",)
+_BEST_MAX_PREFIXES = ("Rewards/", "Game/", "Episodes/")
+
+
+def population_rows(key: str, member_values: np.ndarray) -> Dict[str, float]:
+    """The drained ``Population/*`` rows for one metric: per-member values plus
+    the cross-member ``median`` and (where a direction exists) ``best``.
+
+    Reductions, per namespace (documented contract — howto/population.md):
+
+    * ``Loss/*``            — best = min over members;
+    * ``Rewards/*`` / ``Game/*`` / ``Episodes/*`` — best = max over members;
+    * everything else (``Health/*``, ``Params/*``, ...) — members + median only.
+    """
+    vals = np.asarray(member_values, np.float64).reshape(-1)
+    # non-finite member entries mean "no data this window" (e.g. no finished
+    # episode for that member) — skip the row rather than logging NaN
+    out = {f"Population/{key}/member_{m}": float(v) for m, v in enumerate(vals) if np.isfinite(v)}
+    finite = vals[np.isfinite(vals)]
+    if finite.size:
+        out[f"Population/{key}/median"] = float(np.median(finite))
+        if key.startswith(_BEST_MIN_PREFIXES):
+            out[f"Population/{key}/best"] = float(finite.min())
+        elif key.startswith(_BEST_MAX_PREFIXES):
+            out[f"Population/{key}/best"] = float(finite.max())
+    return out

@@ -81,11 +81,16 @@ def scan_nonfinite(tree: Any, label: str = "") -> List[str]:
     return bad
 
 
-def replay(blackbox_dir: os.PathLike, platform: str = "cpu") -> Tuple[Dict[str, Any], List[str]]:
+def replay(
+    blackbox_dir: os.PathLike, platform: str = "cpu", member: Optional[int] = None
+) -> Tuple[Dict[str, Any], List[str]]:
     """Re-execute the dumped update step; returns ``(outputs, nonfinite_paths)``.
 
     ``outputs`` is whatever the replay target returns (host pytree — typically the
-    update's metrics plus summary norms of the new state).
+    update's metrics plus summary norms of the new state).  ``member`` selects a
+    single population member to replay (``--member``; only replay targets that
+    understand a population axis accept it — currently the Anakin engine's
+    ``engine.anakin:replay_update``).
     """
     _force_platform(platform)
     meta = load_meta(blackbox_dir)
@@ -113,7 +118,17 @@ def replay(blackbox_dir: os.PathLike, platform: str = "cpu") -> Tuple[Dict[str, 
 
     mod_name, _, fn_name = target.rpartition(":")
     fn = getattr(importlib.import_module(mod_name), fn_name)
-    outputs = fn(cfg, Path(blackbox_dir))
+    if member is not None:
+        import inspect
+
+        if "member" not in inspect.signature(fn).parameters:
+            raise SystemExit(
+                f"--member is not supported by this dump's replay target ({target}): "
+                "single-member replay exists for population Anakin dumps only."
+            )
+        outputs = fn(cfg, Path(blackbox_dir), member=int(member))
+    else:
+        outputs = fn(cfg, Path(blackbox_dir))
     return outputs, scan_nonfinite(outputs)
 
 
@@ -122,10 +137,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("blackbox_dir", help="<log_dir>/blackbox directory of a crashed run")
     parser.add_argument("--platform", default="cpu", help="JAX platform to replay on (default: cpu)")
     parser.add_argument("--json", action="store_true", help="emit a JSON report instead of text")
+    parser.add_argument(
+        "--member",
+        type=int,
+        default=None,
+        help="population Anakin dumps: replay only this member's slice of the staged "
+        "carry through the plain single-member program (howto/population.md)",
+    )
     args = parser.parse_args(argv)
 
     meta = load_meta(args.blackbox_dir)
-    outputs, nonfinite = replay(args.blackbox_dir, platform=args.platform)
+    outputs, nonfinite = replay(args.blackbox_dir, platform=args.platform, member=args.member)
 
     if args.json:
         import numpy as np

@@ -325,12 +325,21 @@ def test_anakin_bench_smoke(capsys):
         sys.path.pop(0)
     anakin_bench.main(
         ["--num-envs", "8", "--steps", "64", "--host-steps", "16", "--rollout-steps", "8",
-         "--ppo-envs", "4", "--iters", "2", "--host-envs", "2"]
+         "--ppo-envs", "4", "--iters", "2", "--host-envs", "2",
+         "--members", "2", "--pop-envs", "4", "--pop-rollout", "4", "--pop-iters", "2",
+         "--compile-bench", "0"]
     )
     rows = [json.loads(line) for line in capsys.readouterr().out.splitlines() if line.strip()]
     by_metric = {r["metric"]: r for r in rows}
-    assert set(by_metric) == {"anakin_cartpole_steps_per_sec", "anakin_ppo_grad_steps_per_sec"}
+    assert set(by_metric) == {
+        "anakin_cartpole_steps_per_sec",
+        "anakin_ppo_grad_steps_per_sec",
+        "anakin_population_steps_per_sec",
+    }
     row = by_metric["anakin_cartpole_steps_per_sec"]
     assert row["value"] > 0 and row["speedup_vs_host"] > 0
     assert "host_sync_vector_steps_per_sec" in row and "speedup_vs_raw_gym_saturated" in row
     assert by_metric["anakin_ppo_grad_steps_per_sec"]["value"] > 0
+    pop = by_metric["anakin_population_steps_per_sec"]
+    assert pop["value"] > 0 and pop["members"] == 2
+    assert pop["per_member_efficiency"] > 0 and pop["single_member_steps_per_sec"] > 0

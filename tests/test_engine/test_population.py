@@ -1,0 +1,467 @@
+"""Population Anakin (``sheeprl_tpu/engine/population.py``): the ISSUE-8
+correctness contracts.
+
+* ``population.size=1`` is BIT-IDENTICAL to plain Anakin (params + metrics): the
+  member axis runs through ``lax.scan`` whose body is the unbatched program;
+* K members with identical hyperparameters but different seeds match K separate
+  single-member dispatches member-for-member, bitwise, for PPO and SAC
+  (including the per-member ring counters/stamps);
+* ``algo.population.sweep`` maps hyperparameters across members — a swept
+  learning rate of 0 freezes exactly that member, a swept ``ent_coef`` changes
+  exactly the swept members' updates;
+* ``AnakinFutures.drain`` reduces member-axis metric leaves into
+  ``Population/<metric>/{member_i,median,best}`` rows without extra host syncs;
+* CLI e2e: population train + resume (with a different log cadence) for both
+  algos, preset composition, and single-member blackbox replay.
+"""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.config.core import compose
+from sheeprl_tpu.envs.jax import make_jax_env
+from sheeprl_tpu.engine.population import (
+    PopulationSpec,
+    member_keys,
+    population_rows,
+    population_transform,
+    set_injected_lr,
+    slice_member,
+    stack_members,
+)
+from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
+
+PPO_POP_ARGS = [
+    "exp=ppo",
+    "env=jax_cartpole",
+    "algo.anakin=True",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=8",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.encoder.mlp_features_dim=8",
+]
+
+SAC_POP_ARGS = [
+    "exp=sac",
+    "env=jax_pendulum",
+    "algo.anakin=True",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.hidden_size=8",
+    "algo.per_rank_batch_size=8",
+    "algo.learning_starts=8",
+    "algo.total_steps=64",
+    "algo.anakin_steps_per_dispatch=8",
+    "buffer.size=256",
+]
+
+
+def standard_args(tmp_path, extra=()):
+    return [
+        "dry_run=True",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "checkpoint.every=1",
+        "checkpoint.save_last=True",
+        "metric.log_every=1",
+        f"log_root={tmp_path}",
+        "buffer.memmap=False",
+        "algo.run_test=False",
+        *extra,
+    ]
+
+
+def _ckpts(tmp_path):
+    return sorted(tmp_path.rglob("ckpt_*"), key=lambda p: p.stat().st_mtime)
+
+
+def assert_trees_equal(a, b, b_member=None, label=""):
+    """Bitwise pytree equality; ``b_member`` compares against b's member slice."""
+    for (path, la), lb in zip(jax.tree_util.tree_leaves_with_path(a), jax.tree.leaves(b)):
+        rb = np.asarray(lb)[b_member] if b_member is not None else np.asarray(lb)
+        np.testing.assert_array_equal(
+            np.asarray(la), rb, err_msg=f"{label} diverged at {jax.tree_util.keystr(path)}"
+        )
+
+
+# ------------------------------------------------------------------------- PPO
+def _ppo_setup(num_envs=2, inject_lr=False):
+    cfg = compose(
+        overrides=PPO_POP_ARGS + [f"env.num_envs={num_envs}", "env.capture_video=False", "buffer.memmap=False"]
+    )
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+    from sheeprl_tpu.engine.anakin import make_ppo_anakin_iteration
+
+    env = make_jax_env("cartpole")
+    env_params = env.default_params()
+    obs_space = gym.spaces.Dict({"state": env.observation_space(env_params)})
+    agent, params = build_agent(ctx, env.action_space(env_params), obs_space, cfg)
+    fns = PPOTrainFns(ctx, agent, cfg, ["state"], 4, inject_lr=inject_lr)
+    iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, "state")
+    return cfg, env, env_params, agent, fns, iteration
+
+
+def _ppo_carries(env, env_params, agent, fns, members, num_envs=2, base_params=None, lr_values=None):
+    """Per-member carries with distinct-but-deterministic params (the shared
+    init scaled per member — structure-preserving, no re-init plumbing needed),
+    member-folded env reset keys and the documented ``member_keys`` streams."""
+    from sheeprl_tpu.engine.anakin import init_episode_stats, reset_envs
+
+    base_key = jax.random.PRNGKey(3)
+    keys = member_keys(base_key, members)
+    carries = []
+    for m in range(members):
+        # distinct-but-deterministic per-member params: scale the shared init
+        p = jax.tree.map(lambda x, s=m: x * (1.0 + 0.05 * s) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                         base_params)
+        o = fns.opt.init(p)
+        if lr_values is not None:
+            o = set_injected_lr(o, lr_values[m])
+        env_state, obs0 = reset_envs(env, env_params, num_envs, jax.random.fold_in(jax.random.PRNGKey(7), m))
+        carries.append(
+            {
+                "params": p,
+                "opt_state": o,
+                "env_state": env_state,
+                "obs": obs0,
+                "key": keys[m],
+                "episode_stats": init_episode_stats(num_envs),
+            }
+        )
+    return carries
+
+
+def test_population_size1_bit_identical_to_plain():
+    """The K=1 population dispatch and the plain dispatch produce EXACTLY the
+    same params and metrics from the same initial carry."""
+    cfg, env, env_params, agent, fns, iteration = _ppo_setup()
+    base_params = _fresh_ppo_params(cfg, env, env_params)
+    (carry,) = _ppo_carries(env, env_params, agent, fns, 1, base_params=base_params)
+    plain_carry, plain_metrics = jax.jit(iteration)(carry, 0.2, 0.01)
+    pop = jax.jit(population_transform(iteration, vectorize=False, n_args=2))
+    pop_carry, pop_metrics = pop(
+        stack_members([carry]), jnp.full((1,), 0.2, jnp.float32), jnp.full((1,), 0.01, jnp.float32)
+    )
+    assert_trees_equal(plain_carry, pop_carry, b_member=0, label="carry")
+    assert_trees_equal(plain_metrics, pop_metrics, b_member=0, label="metrics")
+
+
+def test_population_members_match_single_runs_ppo():
+    """K members (same hyperparams, different seeds/inits) match K separate
+    single-member dispatches member-for-member, bitwise — params, optimizer
+    state, env states and metrics."""
+    cfg, env, env_params, agent, fns, iteration = _ppo_setup()
+    base_params = _fresh_ppo_params(cfg, env, env_params)
+    members = 3
+    carries = _ppo_carries(env, env_params, agent, fns, members, base_params=base_params)
+    pop = jax.jit(population_transform(iteration, vectorize=False, n_args=2))
+    pop_carry, pop_metrics = pop(
+        stack_members(carries),
+        jnp.full((members,), 0.2, jnp.float32),
+        jnp.full((members,), 0.01, jnp.float32),
+    )
+    single = jax.jit(iteration)
+    for m in range(members):
+        s_carry, s_metrics = single(carries[m], 0.2, 0.01)
+        assert_trees_equal(s_carry, pop_carry, b_member=m, label=f"member {m} carry")
+        assert_trees_equal(s_metrics, pop_metrics, b_member=m, label=f"member {m} metrics")
+
+
+def _fresh_ppo_params(cfg, env, env_params):
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=123)
+    obs_space = gym.spaces.Dict({"state": env.observation_space(env_params)})
+    _, params = build_agent(ctx, env.action_space(env_params), obs_space, cfg)
+    return params
+
+
+def test_population_ent_coef_sweep_changes_swept_member_only_inputs():
+    """Two members with the SAME seed/init but different ent_coef: the sweep
+    reaches the update (params diverge across members); a zero-vs-zero control
+    stays identical."""
+    cfg, env, env_params, agent, fns, iteration = _ppo_setup()
+    base_params = _fresh_ppo_params(cfg, env, env_params)
+    carries = _ppo_carries(env, env_params, agent, fns, 1, base_params=base_params) * 2  # same member twice
+    pop = jax.jit(population_transform(iteration, vectorize=False, n_args=2))
+    stacked = stack_members(carries)
+    swept_carry, _ = pop(stacked, jnp.full((2,), 0.2, jnp.float32), jnp.asarray([0.0, 0.5], jnp.float32))
+    p0 = jax.device_get(slice_member(swept_carry["params"], 0))
+    p1 = jax.device_get(slice_member(swept_carry["params"], 1))
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1))
+    ), "ent_coef sweep did not reach the members' updates"
+    same_carry, _ = pop(stacked, jnp.full((2,), 0.2, jnp.float32), jnp.zeros((2,), jnp.float32))
+    assert_trees_equal(slice_member(same_carry["params"], 0), same_carry["params"], b_member=1, label="control")
+
+
+def test_population_lr_sweep_freezes_zero_lr_member():
+    """optimizer.lr sweep via inject_hyperparams: the lr=0 member's params stay
+    bit-identical to its init while the lr>0 member trains."""
+    cfg, env, env_params, agent, fns, iteration = _ppo_setup(inject_lr=True)
+    base_params = _fresh_ppo_params(cfg, env, env_params)
+    carries = _ppo_carries(
+        env, env_params, agent, fns, 2, base_params=base_params, lr_values=[0.0, 1e-3]
+    )
+    pop = jax.jit(population_transform(iteration, vectorize=False, n_args=2))
+    new_carry, _ = pop(
+        stack_members(carries), jnp.full((2,), 0.2, jnp.float32), jnp.zeros((2,), jnp.float32)
+    )
+    assert_trees_equal(carries[0]["params"], new_carry["params"], b_member=0, label="lr=0 member moved")
+    p1_new = jax.device_get(slice_member(new_carry["params"], 1))
+    p1_old = jax.device_get(carries[1]["params"])
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(jax.tree.leaves(p1_new), jax.tree.leaves(p1_old))
+    ), "lr=1e-3 member did not train"
+
+
+def test_population_vectorize_mode_matches_map_mode_closely():
+    """`vectorize=True` (jax.vmap member axis) is the same training computation
+    batched — numerically close to the bit-exact map mode, not guaranteed
+    bitwise (XLA may fuse batched ops differently; documented trade-off)."""
+    cfg, env, env_params, agent, fns, iteration = _ppo_setup()
+    base_params = _fresh_ppo_params(cfg, env, env_params)
+    carries = _ppo_carries(env, env_params, agent, fns, 2, base_params=base_params)
+    coefs = (jnp.full((2,), 0.2, jnp.float32), jnp.full((2,), 0.01, jnp.float32))
+    map_carry, map_metrics = jax.jit(population_transform(iteration, vectorize=False, n_args=2))(
+        stack_members(carries), *coefs
+    )
+    vmap_carry, vmap_metrics = jax.jit(population_transform(iteration, vectorize=True, n_args=2))(
+        stack_members(carries), *coefs
+    )
+    for a, b in zip(jax.tree.leaves(jax.device_get(map_carry["params"])),
+                    jax.tree.leaves(jax.device_get(vmap_carry["params"]))):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    for k in map_metrics:
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(map_metrics[k])), np.asarray(jax.device_get(vmap_metrics[k])),
+            rtol=1e-3, atol=1e-4, err_msg=k,
+        )
+
+
+# ------------------------------------------------------------------------- SAC
+def test_population_members_match_single_runs_sac():
+    """SAC population dispatch vs per-member single dispatches: params, ring
+    arrays (incl. write stamps), rows_added/gstep counters and metrics all match
+    bitwise per member."""
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.data.device_buffer import STAMP_KEY, DeviceTransitionRing
+    from sheeprl_tpu.engine.anakin import init_episode_stats, make_sac_anakin_dispatch, reset_envs
+
+    cfg = compose(
+        overrides=SAC_POP_ARGS + ["env.num_envs=2", "env.capture_video=False", "buffer.memmap=False"]
+    )
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+    env = make_jax_env("pendulum")
+    env_params = env.default_params()
+    obs_space = gym.spaces.Dict({"state": env.observation_space(env_params)})
+    act_space = env.action_space(env_params)
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    params = jax.tree.map(jnp.copy, params)
+    ring = DeviceTransitionRing(
+        16, 2, {"obs": ((3,), jnp.float32), "next_obs": ((3,), jnp.float32),
+                "actions": ((1,), jnp.float32), "rewards": ((1,), jnp.float32),
+                "dones": ((1,), jnp.float32)}
+    )
+    actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
+        env, env_params, actor, critic, cfg, act_space, ring, 4
+    )
+    members = 2
+    keys = member_keys(jax.random.PRNGKey(1), members)
+    carries = []
+    for m in range(members):
+        p = jax.tree.map(
+            lambda x, s=m: x * (1.0 + 0.05 * s) if jnp.issubdtype(x.dtype, jnp.floating) else x, params
+        )
+        env_state, obs0 = reset_envs(env, env_params, 2, jax.random.fold_in(jax.random.PRNGKey(0), m))
+        carries.append(
+            {
+                "params": p,
+                "opt_state": {
+                    "actor": actor_opt.init(p["actor"]),
+                    "critic": critic_opt.init(p["critic"]),
+                    "alpha": alpha_opt.init(p["log_alpha"]),
+                },
+                "env_state": env_state,
+                "obs": obs0,
+                "ring": jax.tree.map(jnp.copy, ring.arrays),
+                "rows_added": jnp.zeros((), jnp.int32),
+                "gstep": jnp.zeros((), jnp.int32),
+                "key": keys[m],
+                "episode_stats": init_episode_stats(2),
+            }
+        )
+    program = builder(5, 1, True)
+    pop_carry, pop_metrics = jax.jit(population_transform(program, vectorize=False))(stack_members(carries))
+    single = jax.jit(program)
+    for m in range(members):
+        s_carry, s_metrics = single(carries[m])
+        assert_trees_equal(s_carry, pop_carry, b_member=m, label=f"member {m} carry")
+        assert_trees_equal(s_metrics, pop_metrics, b_member=m, label=f"member {m} metrics")
+    # counters and stamps advanced per member
+    assert np.all(np.asarray(jax.device_get(pop_carry["rows_added"])) == 5)
+    assert np.all(np.asarray(jax.device_get(pop_carry["gstep"])) == 5)
+    stamps = np.asarray(jax.device_get(pop_carry["ring"][STAMP_KEY]))  # [K, n_envs, cap, 1]
+    for m in range(members):
+        np.testing.assert_array_equal(stamps[m, :, :5, 0], np.broadcast_to(np.arange(5), (2, 5)))
+
+
+# ------------------------------------------------------------------- spec/drain
+def test_population_spec_validation():
+    cfg = compose(
+        overrides=PPO_POP_ARGS
+        + ["algo.population.size=2", "env.capture_video=False", "buffer.memmap=False"]
+    )
+    spec = PopulationSpec.from_cfg(cfg, "ppo")
+    assert spec.enabled and spec.size == 2 and not spec.sweep
+
+    cfg.algo.population.sweep = {"ent_coef": [0.0, 0.1]}
+    assert PopulationSpec.from_cfg(cfg, "ppo").sweep == {"ent_coef": (0.0, 0.1)}
+
+    cfg.algo.population.sweep = {"ent_coef": [0.0]}
+    with pytest.raises(ValueError, match="one value per member"):
+        PopulationSpec.from_cfg(cfg, "ppo")
+
+    cfg.algo.population.sweep = {"gamma": [0.9, 0.99]}
+    with pytest.raises(ValueError, match="not sweepable"):
+        PopulationSpec.from_cfg(cfg, "ppo")
+
+    # nested CLI spelling flattens: sweep.critic.optimizer.lr -> critic.optimizer.lr
+    cfg.algo.population.sweep = {"critic": {"optimizer": {"lr": [1e-3, 3e-4]}}}
+    assert PopulationSpec.from_cfg(cfg, "sac").sweep == {"critic.optimizer.lr": (1e-3, 3e-4)}
+
+
+def test_member_keys_contract():
+    base = jax.random.PRNGKey(5)
+    keys = member_keys(base, 3)
+    np.testing.assert_array_equal(np.asarray(keys[0]), np.asarray(base))  # member 0 = base stream
+    np.testing.assert_array_equal(np.asarray(keys[1]), np.asarray(jax.random.fold_in(base, 1)))
+    assert not np.array_equal(np.asarray(keys[1]), np.asarray(keys[2]))
+
+
+def test_anakin_futures_drain_population_reduction():
+    """Member-axis metric leaves drain as Population/<key>/{member_i,median,best}
+    (min for Loss/*, max for reward-like), the plain key logs the cross-member
+    mean, and per-member episode sums derive per-member rew_avg."""
+    from sheeprl_tpu.engine.anakin import AnakinFutures
+    from sheeprl_tpu.utils.metric import MetricAggregator
+
+    futures = AnakinFutures()
+    aggregator = MetricAggregator({})
+    metrics = {
+        "Loss/value_loss": jnp.asarray([1.0, 3.0, 2.0]),
+        "Health/grad_norm": jnp.asarray([0.1, 0.2, 0.3]),
+        "Episodes/return_sum": jnp.asarray([10.0, 0.0, 30.0]),
+        "Episodes/len_sum": jnp.asarray([20.0, 0.0, 30.0]),
+        "Episodes/count": jnp.asarray([2.0, 0.0, 1.0]),
+    }
+    futures.track(metrics, env_steps=300, grad_steps=30)
+    out = futures.drain(aggregator)
+
+    assert out["Population/Loss/value_loss/member_1"] == 3.0
+    assert out["Population/Loss/value_loss/median"] == 2.0
+    assert out["Population/Loss/value_loss/best"] == 1.0  # Loss: best = min
+    # Health: members + median, no "best"
+    assert out["Population/Health/grad_norm/median"] == pytest.approx(0.2)
+    assert "Population/Health/grad_norm/best" not in out
+    # per-member episode means; member 1 had no episodes -> no row
+    assert out["Population/Rewards/rew_avg/member_0"] == pytest.approx(5.0)
+    assert out["Population/Rewards/rew_avg/member_2"] == pytest.approx(30.0)
+    assert "Population/Rewards/rew_avg/member_1" not in out
+    assert out["Population/Rewards/rew_avg/best"] == pytest.approx(30.0)  # reward: best = max
+    agg = aggregator.compute()
+    assert agg["Loss/value_loss"] == pytest.approx(2.0)  # plain key = member mean
+    assert agg["Rewards/rew_avg"] == pytest.approx((5.0 + 30.0) / 2)
+
+
+def test_population_rows_reduction_units():
+    rows = population_rows("Loss/x", np.asarray([2.0, np.nan, 1.0]))
+    assert rows["Population/Loss/x/best"] == 1.0 and "Population/Loss/x/member_1" not in rows
+    rows = population_rows("Rewards/x", np.asarray([2.0, 5.0]))
+    assert rows["Population/Rewards/x/best"] == 5.0 and rows["Population/Rewards/x/median"] == 3.5
+
+
+# -------------------------------------------------------------------- CLI e2e
+def test_ppo_population_cli_smoke_and_resume_with_new_cadence(tmp_path):
+    """Population train + checkpoint, then resume the stacked carry with a
+    DIFFERENT metric.log_every — the member axis round-trips through the
+    CheckpointManager and the log cadence is free to change across runs — and
+    finally the eval entry digs member 0's policy out of the stacked carry."""
+    from sheeprl_tpu.cli import evaluate
+
+    args = PPO_POP_ARGS + [
+        "algo.total_steps=32",
+        "algo.population.size=3",
+        "algo.population.sweep.ent_coef=[0.0,0.01,0.1]",
+    ]
+    run(args + standard_args(tmp_path))
+    ckpts = _ckpts(tmp_path)
+    assert ckpts, "no checkpoint written"
+    run(
+        args
+        + [f"checkpoint.resume_from={ckpts[-1]}"]
+        + standard_args(tmp_path, extra=["metric.log_every=64"])
+    )
+    evaluate([f"checkpoint_path={_ckpts(tmp_path)[-1]}", "env.capture_video=False"])
+
+
+@pytest.mark.slow
+def test_sac_population_cli_smoke_and_resume(tmp_path):
+    """Slow tier: the SAC population CLI round trip (the fast tier keeps the
+    builder-level SAC member parity test + the PPO population CLI smoke, and CI
+    runs its own population train+resume smoke)."""
+    args = SAC_POP_ARGS + [
+        "algo.population.size=2",
+        "algo.population.sweep.critic.optimizer.lr=[0.001,0.0003]",
+    ]
+    extra = ["dry_run=False", "checkpoint.every=16", "metric.log_every=16"]
+    run(args + standard_args(tmp_path, extra=extra))
+    ckpts = _ckpts(tmp_path)
+    assert ckpts, "no checkpoint written"
+    run(
+        args
+        + [f"checkpoint.resume_from={ckpts[-1]}", "algo.total_steps=96"]
+        + standard_args(tmp_path, extra=["dry_run=False", "checkpoint.every=16", "metric.log_every=32"])
+    )
+
+
+def test_population_exp_presets_compose():
+    for exp, size in (("ppo_anakin_pop", 16), ("sac_anakin_pop", 16)):
+        cfg = compose(overrides=[f"exp={exp}"])
+        assert cfg.algo.anakin and cfg.env.jax.enabled
+        assert int(cfg.algo.population.size) == size
+        assert cfg.algo.mlp_keys.encoder == ["state"]
+
+
+@pytest.mark.slow
+def test_population_nan_injection_dumps_and_replays_single_member(tmp_path):
+    """Slow tier (crash + dump + rebuild): strict-mode forensics for a
+    population run — the blackbox stages the STACKED carry; --member replays one
+    member's slice through the plain single-member program on CPU and reproduces
+    the non-finite metrics."""
+    from sheeprl_tpu.analysis.strict import NonFiniteError
+    from sheeprl_tpu.obs import replay_blackbox
+
+    with pytest.raises(NonFiniteError, match="inject_nan"):
+        run(
+            PPO_POP_ARGS
+            + [
+                "algo.population.size=2",
+                "analysis.strict=True",
+                "analysis.inject_nan=True",
+            ]
+            + standard_args(tmp_path, extra=["checkpoint.every=0", "checkpoint.save_last=False"])
+        )
+    dumps = list(tmp_path.rglob("blackbox"))
+    assert dumps, "no blackbox directory written"
+    outputs, nonfinite = replay_blackbox.replay(dumps[0], member=1)
+    assert outputs.get("member") == 1
+    assert nonfinite, "single-member replay did not reproduce the injected non-finite metrics"
