@@ -272,6 +272,53 @@ def bench_anakin() -> list:
     return [json.loads(line) for line in buf.getvalue().splitlines() if line.strip()]
 
 
+def bench_fault() -> list:
+    """Checkpoint fault-tolerance cost rows (ISSUE-10): wall-clock of one
+    integrity-checked ``CheckpointManager.save`` (fsync + sha256 manifest) and of
+    the matching verified restore path (``latest_valid`` discovery + checksum
+    verify + deserialize) on a PPO-sized state pytree.  Lower is better — these
+    bound the preemption grace window and the supervisor's resume latency.  Set
+    ``BENCH_FAULT=0`` to skip."""
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+
+    rng = np.random.default_rng(0)
+    # ~64 MB of params/opt-state shaped like a mid-size host-loop checkpoint.
+    state = {
+        "params": {f"layer_{i}": rng.standard_normal((1024, 1024)).astype(np.float32) for i in range(8)},
+        "opt_state": {f"mu_{i}": rng.standard_normal((1024, 1024)).astype(np.float32) for i in range(8)},
+        "policy_step": 1024,
+        "update": 16,
+    }
+    tmp = tempfile.mkdtemp(prefix="bench_fault_")
+    try:
+        manager = CheckpointManager(os.path.join(tmp, "checkpoints"), keep_last=3)
+        reps = int(os.environ.get("BENCH_FAULT_REPS", "3"))
+        save_times, restore_times = [], []
+        for rep in range(reps):
+            t0 = time.perf_counter()
+            manager.save((rep + 1) * 100, state)
+            save_times.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            latest = CheckpointManager.latest_valid(os.path.join(tmp, "checkpoints"))
+            CheckpointManager.load(latest, fallback=True)
+            restore_times.append(time.perf_counter() - t0)
+        mb = sum(a.nbytes for tree in (state["params"], state["opt_state"]) for a in tree.values()) / 2**20
+        return [
+            {
+                "metric": "checkpoint_save_seconds",
+                "value": round(float(np.median(save_times)), 4),
+                "unit": f"seconds (fsync'd integrity-manifest save, {mb:.0f} MB state, median of {reps})",
+            },
+            {
+                "metric": "resume_restore_seconds",
+                "value": round(float(np.median(restore_times)), 4),
+                "unit": f"seconds (latest_valid + checksum verify + load, {mb:.0f} MB state, median of {reps})",
+            },
+        ]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_ir_audit() -> dict:
     """Wall-clock of the full ``jaxlint-ir`` audit (``sheeprl_tpu/analysis/ir``):
     AOT-lower + compile + rule-check every entry point's jitted update and both
@@ -320,6 +367,13 @@ def main() -> None:
                 print(json.dumps(row))
         except Exception as exc:
             print(json.dumps({"metric": "anakin_cartpole_steps_per_sec", "error": str(exc)[:200]}))
+    # Fault-tolerance cost rows (ISSUE-10): checkpoint save + verified restore.
+    if os.environ.get("BENCH_FAULT", "1") != "0":
+        try:
+            for row in bench_fault():
+                print(json.dumps(row))
+        except Exception as exc:
+            print(json.dumps({"metric": "checkpoint_save_seconds", "error": str(exc)[:200]}))
     # DroQ UTD-20 fused-block row: same auxiliary-row contract.
     if os.environ.get("BENCH_DROQ", "1") != "0":
         try:

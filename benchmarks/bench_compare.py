@@ -41,7 +41,7 @@ _LOWER_BETTER_HINTS = ("ms", "latency", "time", "seconds")
 _HIGHER_BETTER_PREFIXES = ("anakin_",)
 # ...EXCEPT the compile-cache wall-clock row, which is a duration: exact-name
 # pins win over the prefix pin.
-_LOWER_BETTER_METRICS = ("anakin_compile_seconds",)
+_LOWER_BETTER_METRICS = ("anakin_compile_seconds", "checkpoint_save_seconds", "resume_restore_seconds")
 
 
 def extract_metrics(path: str) -> Dict[str, Tuple[float, str]]:

@@ -22,6 +22,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, value_loss
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.algos.ppo.utils import log_prob_and_entropy, prepare_obs, sample_actions, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
@@ -114,6 +115,7 @@ def main(ctx, cfg) -> None:
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    guard = TrainingGuard(cfg, log_dir)
 
     gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
 
@@ -234,14 +236,10 @@ def main(ctx, cfg) -> None:
             aggregator.reset()
             last_log = policy_step
 
-        if (
-            cfg.checkpoint.every > 0
-            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
-            or update == num_updates
-            and cfg.checkpoint.save_last
-        ):
+        def save_ckpt():
+            nonlocal last_checkpoint
             with monitor.phase("checkpoint"):
-                ckpt_manager.save(
+                path = ckpt_manager.save(
                     policy_step,
                     {
                         "params": params,
@@ -253,6 +251,16 @@ def main(ctx, cfg) -> None:
                     },
                 )
             last_checkpoint = policy_step
+            return path
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or update == num_updates
+            and cfg.checkpoint.save_last
+        ):
+            save_ckpt()
+        guard.boundary(policy_step, save_ckpt)
 
     monitor.close()
     envs.close()

@@ -46,6 +46,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
 )
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_device_replay
@@ -435,6 +436,7 @@ def main(ctx, cfg) -> None:
     aggregator = make_aggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    guard = TrainingGuard(cfg, log_dir)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
 
     batch_size = cfg.algo.per_rank_batch_size
@@ -631,12 +633,8 @@ def main(ctx, cfg) -> None:
             # Checkpoint BEFORE the log flush so phase_checkpoint lands in the
             # window it was paid in (and the final save_last is not dropped from
             # the breakdown).
-            if (
-                cfg.checkpoint.every > 0
-                and (policy_step - last_checkpoint) >= cfg.checkpoint.every
-                or iter_num == num_iters
-                and cfg.checkpoint.save_last
-            ):
+            def save_ckpt():
+                nonlocal last_checkpoint
                 state = {
                     "params": params,
                     "opt_states": opt_states,
@@ -651,8 +649,17 @@ def main(ctx, cfg) -> None:
                 with monitor.phase("checkpoint"):
                     if cfg.buffer.checkpoint:
                         state["rb"] = rb.state_dict()
-                    ckpt_manager.save(policy_step, state)
+                    path = ckpt_manager.save(policy_step, state)
                 last_checkpoint = policy_step
+                return path
+
+            if (
+                cfg.checkpoint.every > 0
+                and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+                or iter_num == num_iters
+                and cfg.checkpoint.save_last
+            ):
+                save_ckpt()
 
             if logger is not None and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == num_iters or cfg.dry_run
@@ -680,6 +687,7 @@ def main(ctx, cfg) -> None:
                 monitor.log_metrics(logger, metrics, policy_step)
                 aggregator.reset()
                 last_log = policy_step
+            guard.boundary(policy_step, save_ckpt)
 
     finally:
         monitor.close()

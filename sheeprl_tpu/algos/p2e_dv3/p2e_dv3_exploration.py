@@ -45,6 +45,7 @@ from sheeprl_tpu.algos.p2e_dv3.utils import (
 )
 from sheeprl_tpu.algos.ppo.ppo import make_optimizer
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_device_replay
@@ -525,6 +526,7 @@ def main(ctx, cfg) -> None:
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    guard = TrainingGuard(cfg, log_dir)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
 
     batch_size = cfg.algo.per_rank_batch_size
@@ -700,12 +702,8 @@ def main(ctx, cfg) -> None:
             aggregator.reset()
             last_log = policy_step
 
-        if (
-            cfg.checkpoint.every > 0
-            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
-            or iter_num == num_iters
-            and cfg.checkpoint.save_last
-        ):
+        def save_ckpt():
+            nonlocal last_checkpoint
             state = {
                 "params": params,
                 "opt_states": opt_states,
@@ -719,8 +717,18 @@ def main(ctx, cfg) -> None:
             }
             if cfg.buffer.checkpoint:
                 state["rb"] = rb.state_dict()
-            ckpt_manager.save(policy_step, state)
+            path = ckpt_manager.save(policy_step, state)
             last_checkpoint = policy_step
+            return path
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or iter_num == num_iters
+            and cfg.checkpoint.save_last
+        ):
+            save_ckpt()
+        guard.boundary(policy_step, save_ckpt)
 
     monitor.close()
     envs.close()

@@ -29,6 +29,7 @@ from sheeprl_tpu.algos.p2e_dv3.agent import build_agent, parse_actions_dim
 from sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration import make_train_step as make_expl_train_step
 from sheeprl_tpu.algos.p2e_dv3.utils import AGGREGATOR_KEYS, init_moments, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_device_replay
@@ -181,6 +182,7 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    guard = TrainingGuard(cfg, log_dir)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
 
     batch_size = cfg.algo.per_rank_batch_size
@@ -334,15 +336,8 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             aggregator.reset()
             last_log = policy_step
 
-        if (
-            cfg.checkpoint.every > 0
-            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
-            or iter_num == num_iters
-            and cfg.checkpoint.save_last
-        ):
-            # Save the exploration-shaped state so both resume (this entry) and
-            # evaluation can reload it with the same templates; untrained entries
-            # keep the optimizer moments loaded from the exploration checkpoint.
+        def save_ckpt():
+            nonlocal last_checkpoint
             full_opts = dict(loaded_opts)
             on_device = jax.device_get(opt_states)
             full_opts["world_model"] = on_device["world_model"]
@@ -363,8 +358,21 @@ def main(ctx, cfg, exploration_cfg=None) -> None:
             }
             if cfg.buffer.checkpoint:
                 ckpt_state["rb"] = rb.state_dict()
-            ckpt_manager.save(policy_step, ckpt_state)
+            path = ckpt_manager.save(policy_step, ckpt_state)
             last_checkpoint = policy_step
+            return path
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or iter_num == num_iters
+            and cfg.checkpoint.save_last
+        ):
+            # Save the exploration-shaped state so both resume (this entry) and
+            # evaluation can reload it with the same templates; untrained entries
+            # keep the optimizer moments loaded from the exploration checkpoint.
+            save_ckpt()
+        guard.boundary(policy_step, save_ckpt)
 
     monitor.close()
     envs.close()

@@ -43,6 +43,7 @@ from sheeprl_tpu.algos.ppo.utils import (
     test,
 )
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
@@ -281,6 +282,7 @@ def main(ctx, cfg) -> None:
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    guard = TrainingGuard(cfg, log_dir)
 
     act_fn, values_fn, train_fn, gae_fn = fns.act_fn, fns.values_fn, fns.train_fn, fns.gae_fn
     # analysis.strict: signature guard on the jitted update (drift -> hard error)
@@ -443,14 +445,10 @@ def main(ctx, cfg) -> None:
             aggregator.reset()
             last_log = policy_step
 
-        if (
-            cfg.checkpoint.every > 0
-            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
-            or update == num_updates
-            and cfg.checkpoint.save_last
-        ):
+        def save_ckpt():
+            nonlocal last_checkpoint
             with monitor.phase("checkpoint"):
-                ckpt_manager.save(
+                path = ckpt_manager.save(
                     policy_step,
                     {
                         "params": params,
@@ -462,6 +460,16 @@ def main(ctx, cfg) -> None:
                     },
                 )
             last_checkpoint = policy_step
+            return path
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or update == num_updates
+            and cfg.checkpoint.save_last
+        ):
+            save_ckpt()
+        guard.boundary(policy_step, save_ckpt)
 
     monitor.close()
     envs.close()

@@ -41,6 +41,7 @@ from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import TrainingMonitor
@@ -105,6 +106,7 @@ def main(ctx, cfg) -> None:
     # learner (logging flush) — one lock covers both sides.
     agg_lock = threading.Lock()
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    guard = TrainingGuard(cfg, log_dir)
 
     # ------------------------------------------------------------------ resume
     start_update = 1
@@ -287,13 +289,9 @@ def main(ctx, cfg) -> None:
                 monitor.log_metrics(logger, metrics, policy_step)
                 last_log = policy_step
 
-            if (
-                cfg.checkpoint.every > 0
-                and (policy_step - last_checkpoint) >= cfg.checkpoint.every
-                or update == num_updates
-                and cfg.checkpoint.save_last
-            ):
-                ckpt_manager.save(
+            def save_ckpt():
+                nonlocal last_checkpoint
+                path = ckpt_manager.save(
                     policy_step,
                     {
                         "params": params,
@@ -305,6 +303,16 @@ def main(ctx, cfg) -> None:
                     },
                 )
                 last_checkpoint = policy_step
+                return path
+
+            if (
+                cfg.checkpoint.every > 0
+                and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+                or update == num_updates
+                and cfg.checkpoint.save_last
+            ):
+                save_ckpt()
+            guard.boundary(policy_step, save_ckpt)
     finally:
         stop.set()
         player_thread.join(timeout=30)

@@ -23,6 +23,7 @@ from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, log_prob_and_entropy, p
 from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, build_agent, make_zero_state
 from sheeprl_tpu.analysis.strict import assert_finite, maybe_inject_nonfinite, strict_guard
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
@@ -166,6 +167,7 @@ def main(ctx, cfg) -> None:
     aggregator = MetricAggregator(cfg.metric.aggregator.get("metrics", {}))
     aggregator.keep(AGGREGATOR_KEYS | set(cfg.metric.aggregator.get("metrics", {})))
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    guard = TrainingGuard(cfg, log_dir)
 
     gamma, gae_lambda = cfg.algo.gamma, cfg.algo.gae_lambda
 
@@ -327,13 +329,9 @@ def main(ctx, cfg) -> None:
             aggregator.reset()
             last_log = policy_step
 
-        if (
-            cfg.checkpoint.every > 0
-            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
-            or update == num_updates
-            and cfg.checkpoint.save_last
-        ):
-            ckpt_manager.save(
+        def save_ckpt():
+            nonlocal last_checkpoint
+            path = ckpt_manager.save(
                 policy_step,
                 {
                     "params": params,
@@ -345,6 +343,16 @@ def main(ctx, cfg) -> None:
                 },
             )
             last_checkpoint = policy_step
+            return path
+
+        if (
+            cfg.checkpoint.every > 0
+            and (policy_step - last_checkpoint) >= cfg.checkpoint.every
+            or update == num_updates
+            and cfg.checkpoint.save_last
+        ):
+            save_ckpt()
+        guard.boundary(policy_step, save_ckpt)
 
     monitor.close()
     envs.close()

@@ -31,6 +31,7 @@ from sheeprl_tpu.algos.sac.agent import build_agent
 from sheeprl_tpu.algos.sac.sac import make_sac_fused_builder, make_sac_train_fn
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, prepare_obs, test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_transition_ring
@@ -98,6 +99,7 @@ def main(ctx, cfg) -> None:
     # Written by the player (episode stats) and read/reset by the learner.
     agg_lock = threading.Lock()
     ckpt_manager = CheckpointManager(Path(log_dir) / "checkpoints", keep_last=cfg.checkpoint.keep_last)
+    guard = TrainingGuard(cfg, log_dir)
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     batch_size = cfg.algo.per_rank_batch_size
 
@@ -412,6 +414,29 @@ def main(ctx, cfg) -> None:
                     state["rb"] = item["ckpt"]["rb"]
                 ckpt_manager.save(policy_step, state)
                 last_checkpoint = policy_step
+
+            def save_ckpt():
+                # Preemption-time save. The replay buffer lives in the player
+                # thread and cannot be snapshotted coherently from here, so the
+                # emergency checkpoint carries everything but "rb" (resume
+                # tolerates its absence); ratio's state_dict is a plain scalar
+                # copy and safe to read across threads.
+                nonlocal last_checkpoint
+                state = {
+                    "params": params,
+                    "opt_state": opt_state,
+                    "ratio": ratio.state_dict(),
+                    "iter_num": iter_num,
+                    "policy_step": policy_step,
+                    "last_log": last_log,
+                    "last_checkpoint": policy_step,
+                    "cumulative_grad_steps": cumulative_grad_steps,
+                }
+                path = ckpt_manager.save(policy_step, state)
+                last_checkpoint = policy_step
+                return path
+
+            guard.boundary(policy_step, save_ckpt)
     finally:
         stop.set()
         player_thread.join(timeout=30)

@@ -194,6 +194,18 @@ def run_algorithm(cfg: DotDict) -> None:
             _cc.reset_cache()
         except Exception:  # pragma: no cover - experimental API surface
             pass
+    # Fault layer (sheeprl_tpu/fault, howto/fault_tolerance.md): SIGTERM/SIGINT
+    # become a sticky flag every training loop polls at its safe boundary (one
+    # final checkpoint + PREEMPTED marker + exit 75), and any scheduled chaos
+    # faults are parsed before EnvPool forks its workers so the worker-fault spec
+    # rides the fork.
+    from sheeprl_tpu.fault import chaos as fault_chaos
+    from sheeprl_tpu.fault import install_signal_handlers
+    from sheeprl_tpu.fault.preemption import Preempted
+
+    install_signal_handlers(grace_seconds=cfg.get("fault", {}).get("grace_seconds", 0))
+    fault_chaos.install(cfg)
+
     maybe_init_distributed(cfg.get("mesh", {}))
     ctx = make_mesh_context(cfg)
 
@@ -211,6 +223,10 @@ def run_algorithm(cfg: DotDict) -> None:
 
     try:
         entry["entrypoint"](ctx, cfg, **kwargs)
+    except Preempted:
+        # Graceful preemption is not a crash: the boundary checkpoint and the
+        # PREEMPTED marker are already on disk — no blackbox dump.
+        raise
     except Exception as exc:
         dump = flight_recorder.dump_active("crash", exc)
         if dump:
@@ -294,7 +310,83 @@ def run(args: Optional[List[str]] = None) -> None:
         check_configs(cfg)
         if os.environ.get("SHEEPRL_TPU_QUIET", "0") != "1":
             print_config(cfg)
-        run_algorithm(cfg)
+        _run_with_autoresume(cfg)
+
+
+def _run_with_autoresume(cfg: DotDict) -> None:
+    """Run one job under the fault policy (``fault`` config group).
+
+    Without ``fault.autoresume``: a graceful preemption exits with the resumable
+    code 75 (EX_TEMPFAIL) so fleet schedulers / ``sheeprl_tpu.supervise`` relaunch
+    it; every other exception propagates as usual (after the blackbox dump).
+
+    With ``fault.autoresume=True``: preemptions resume immediately from the
+    boundary checkpoint and retryable crashes relaunch from the latest *valid*
+    checkpoint with bounded exponential backoff — the in-process mirror of
+    ``python -m sheeprl_tpu.supervise`` (which alone survives SIGKILL/OOM).
+    """
+    import time
+
+    from sheeprl_tpu.fault import classify as fault_classify
+    from sheeprl_tpu.fault import counters as fault_counters
+    from sheeprl_tpu.fault import preemption as fault_preemption
+    from sheeprl_tpu.fault.supervisor import (
+        backoff_seconds,
+        fault_cfg,
+        find_resume_checkpoint,
+        run_dir_for,
+    )
+
+    f_cfg = fault_cfg(cfg)
+    autoresume = bool(f_cfg.get("autoresume", False))
+    max_retries = int(f_cfg.get("max_retries", 3))
+    retries = 0
+    while True:
+        try:
+            run_algorithm(cfg)
+            return
+        except fault_preemption.Preempted as p:
+            if not autoresume:
+                print(
+                    f"preempted at step {p.step}; resumable checkpoint: "
+                    f"{p.ckpt_path or 'none'} (exit {fault_preemption.RESUMABLE_EXIT_CODE})",
+                    file=sys.stderr,
+                )
+                raise SystemExit(fault_preemption.RESUMABLE_EXIT_CODE)
+            fault_preemption.clear_preemption()
+            fault_counters.bump("Fault/restarts")
+            resume = p.ckpt_path or find_resume_checkpoint(run_dir_for(cfg))
+            print(
+                f"fault.autoresume: preempted at step {p.step}; resuming"
+                + (f" from {resume}" if resume else " from scratch"),
+                file=sys.stderr,
+            )
+        except Exception as exc:
+            if not autoresume:
+                raise
+            if fault_classify.classify_exception(exc) == fault_classify.FATAL:
+                print(
+                    f"fault.autoresume: {type(exc).__name__} is deterministic — not retrying",
+                    file=sys.stderr,
+                )
+                raise
+            retries += 1
+            if retries > max_retries:
+                print(f"fault.autoresume: exceeded fault.max_retries={max_retries}", file=sys.stderr)
+                raise
+            fault_counters.bump("Fault/restarts")
+            delay = backoff_seconds(
+                retries, float(f_cfg.get("backoff_s", 2.0)), float(f_cfg.get("backoff_max_s", 60.0))
+            )
+            print(
+                f"fault.autoresume: {type(exc).__name__}; retry {retries}/{max_retries} "
+                f"in {delay:.1f}s",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+            resume = find_resume_checkpoint(run_dir_for(cfg))
+        if resume:
+            cfg.checkpoint.resume_from = str(resume)
 
 
 def _load_checkpoint_cfg(overrides: List[str], path_key: str) -> tuple:

@@ -49,6 +49,7 @@ from sheeprl_tpu.analysis.strict import maybe_inject_nonfinite, nan_scan, strict
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.envs.jax import make_jax_env
+from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import health_enabled
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -448,6 +449,25 @@ def ppo_anakin(ctx, cfg) -> None:
     grad_steps_per_update = fns.grad_steps_per_update
     clip0 = pop.values("clip_coef", cfg.algo.clip_coef) if pop.enabled else [float(cfg.algo.clip_coef)]
     ent0 = pop.values("ent_coef", cfg.algo.ent_coef) if pop.enabled else [float(cfg.algo.ent_coef)]
+
+    guard = TrainingGuard(cfg, log_dir)
+
+    def save_ckpt():
+        nonlocal last_checkpoint
+        with monitor.phase("checkpoint"):
+            path = ckpt_manager.save(
+                policy_step,
+                {
+                    "carry": carry,
+                    "update": update,
+                    "policy_step": policy_step,
+                    "last_log": last_log,
+                    "last_checkpoint": policy_step,
+                },
+            )
+        last_checkpoint = policy_step
+        return path
+
     for update in range(start_update, num_updates + 1):
         monitor.advance()
         clip_coef, ent_coef = list(clip0), list(ent0)
@@ -496,18 +516,8 @@ def ppo_anakin(ctx, cfg) -> None:
             or update == num_updates
             and cfg.checkpoint.save_last
         ):
-            with monitor.phase("checkpoint"):
-                ckpt_manager.save(
-                    policy_step,
-                    {
-                        "carry": carry,
-                        "update": update,
-                        "policy_step": policy_step,
-                        "last_log": last_log,
-                        "last_checkpoint": policy_step,
-                    },
-                )
-            last_checkpoint = policy_step
+            save_ckpt()
+        guard.boundary(policy_step, save_ckpt)
 
     monitor.close()
     if cfg.algo.run_test and ctx.is_global_zero:
@@ -844,27 +854,33 @@ def sac_anakin(ctx, cfg) -> None:
             aggregator.reset()
             last_log = policy_step
 
-    def _maybe_checkpoint(final: bool) -> None:
+    def save_ckpt():
         nonlocal last_checkpoint
+        ckpt_carry = carry if cfg.buffer.checkpoint else {k: v for k, v in carry.items() if k != "ring"}
+        with monitor.phase("checkpoint"):
+            path = ckpt_manager.save(
+                policy_step,
+                {
+                    "carry": ckpt_carry,
+                    "iter_num": iter_num,
+                    "policy_step": policy_step,
+                    "last_log": last_log,
+                    "last_checkpoint": policy_step,
+                },
+            )
+        last_checkpoint = policy_step
+        return path
+
+    def _maybe_checkpoint(final: bool) -> None:
         if (
             cfg.checkpoint.every > 0
             and (policy_step - last_checkpoint) >= cfg.checkpoint.every
             or final
             and cfg.checkpoint.save_last
         ):
-            ckpt_carry = carry if cfg.buffer.checkpoint else {k: v for k, v in carry.items() if k != "ring"}
-            with monitor.phase("checkpoint"):
-                ckpt_manager.save(
-                    policy_step,
-                    {
-                        "carry": ckpt_carry,
-                        "iter_num": iter_num,
-                        "policy_step": policy_step,
-                        "last_log": last_log,
-                        "last_checkpoint": policy_step,
-                    },
-                )
-            last_checkpoint = policy_step
+            save_ckpt()
+
+    guard = TrainingGuard(cfg, log_dir)
 
     # Prefill: one dispatch of uniform random acting (a resumed run already has a
     # trained policy and a restored ring — skip it, like the host loops).
@@ -876,6 +892,7 @@ def sac_anakin(ctx, cfg) -> None:
         policy_step += (prefill_steps - iter_num) * num_envs
         iter_num = prefill_steps
         stage_carry(recorder, carry, iter_num=iter_num)
+        guard.boundary(policy_step, save_ckpt)
 
     while iter_num < num_iters:
         monitor.advance()
@@ -889,6 +906,7 @@ def sac_anakin(ctx, cfg) -> None:
         final = iter_num >= num_iters
         _maybe_log(final)
         _maybe_checkpoint(final)
+        guard.boundary(policy_step, save_ckpt)
 
     monitor.close()
     if cfg.algo.run_test and ctx.is_global_zero:

@@ -1,0 +1,60 @@
+"""Fault tolerance: graceful preemption, autoresume, checkpoint integrity, chaos.
+
+The north star runs on preemptible accelerator fleets (Podracer, arXiv:2104.06272),
+where eviction mid-run is the normal case, not the exception.  This package is the
+recovery half of the observability story: the flight recorder (``sheeprl_tpu/obs``)
+diagnoses a dead run, ``sheeprl_tpu.fault`` keeps it alive —
+
+* :mod:`~sheeprl_tpu.fault.preemption` — SIGTERM/SIGINT become a sticky flag that
+  every training loop checks at its safe boundary (between updates, where a
+  checkpoint is consistent), cuts one final checkpoint, writes a ``PREEMPTED``
+  marker and exits with :data:`RESUMABLE_EXIT_CODE`;
+* :mod:`~sheeprl_tpu.fault.guard` — :class:`TrainingGuard`, the one-line boundary
+  hook the entry points call once per update;
+* :mod:`~sheeprl_tpu.fault.supervisor` — ``python -m sheeprl_tpu.supervise``
+  relaunches a crashed/preempted run from the latest *valid* checkpoint with
+  bounded exponential backoff; ``fault.autoresume=True`` does the same in-process;
+* :mod:`~sheeprl_tpu.fault.classify` — the retry/fatal matrix (non-finite loss is
+  deterministic: never retried; preemptions and worker crashes are transient:
+  always retried);
+* :mod:`~sheeprl_tpu.fault.chaos` — a seeded, deterministic fault schedule
+  (``chaos`` config group) that kills the process, corrupts a checkpoint, hangs a
+  rollout worker or delays a dispatch at step N, so the e2e tests *prove*
+  kill+resume reaches the same final params as an uninterrupted run;
+* :mod:`~sheeprl_tpu.fault.counters` — ``Fault/*`` metrics merged into every
+  metric flush by ``TrainingMonitor.log_metrics``.
+
+See ``howto/fault_tolerance.md`` for the operator-facing guarantees.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.fault.counters import bump as bump_counter
+from sheeprl_tpu.fault.counters import fault_metrics
+from sheeprl_tpu.fault.guard import TrainingGuard
+from sheeprl_tpu.fault.preemption import (
+    PREEMPTED_MARKER,
+    RESUMABLE_EXIT_CODE,
+    Preempted,
+    clear_preemption,
+    install_signal_handlers,
+    preemption_requested,
+    read_marker,
+    request_preemption,
+    write_marker,
+)
+
+__all__ = [
+    "PREEMPTED_MARKER",
+    "RESUMABLE_EXIT_CODE",
+    "Preempted",
+    "TrainingGuard",
+    "bump_counter",
+    "clear_preemption",
+    "fault_metrics",
+    "install_signal_handlers",
+    "preemption_requested",
+    "read_marker",
+    "request_preemption",
+    "write_marker",
+]

@@ -1,0 +1,215 @@
+"""Deterministic chaos harness: a seeded fault schedule driven by the ``chaos``
+config group.
+
+Generalizes ``analysis.inject_nan`` (one hard-coded fault) into a schedule of
+*infrastructure* faults, each pinned to a policy step so every chaos run is exactly
+reproducible:
+
+* ``chaos.kill_at_step=N``      — deliver ``chaos.kill_signal`` (SIGTERM | SIGKILL)
+  to this process at the first loop boundary past step N.  SIGTERM exercises the
+  graceful-preemption path (boundary checkpoint + ``PREEMPTED`` marker + exit 75);
+  SIGKILL exercises the supervisor's crash-resume path (no goodbye at all).
+* ``chaos.corrupt_ckpt_at_step=N`` + ``chaos.corrupt_mode=bitflip|truncate``
+  — damage the newest *published* checkpoint (seeded byte, so the damage is
+  deterministic), proving ``CheckpointManager.load`` falls back to the previous
+  valid checkpoint instead of deserializing garbage.
+* ``chaos.delay_at_step=N`` + ``chaos.delay_ms`` — stall one loop boundary
+  (elastic-timing faults: slow NFS, a throttled host).
+* ``chaos.worker_fault_at_step=N`` + ``chaos.worker_fault_mode=crash|hang`` +
+  ``chaos.worker_index=i`` — make EnvPool worker *i* crash (``os._exit``) or hang
+  (sleep past the step timeout) at its N-th step command, exercising the pool's
+  restart machinery.  The spec rides the fork into the worker process
+  (``rollout/worker.py`` polls :func:`maybe_worker_fault`); only generation 0
+  fires, so the restarted replacement worker runs clean.
+
+Step triggers are *edge* triggers: a fault fires when the step counter crosses its
+threshold, and a run resumed past the threshold (in-process or via the supervisor)
+never re-fires it — that is what makes kill-at-step-N + autoresume a terminating,
+deterministic experiment.
+
+Stdlib-only at import: forked EnvPool workers import this and must stay JAX-free.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+import warnings
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.fault import counters as _counters
+from sheeprl_tpu.obs import flight_recorder as _flight_recorder
+
+_KILL_SIGNALS = {"SIGTERM": signal.SIGTERM, "SIGINT": signal.SIGINT, "SIGKILL": signal.SIGKILL}
+_CORRUPT_MODES = ("bitflip", "truncate")
+_WORKER_MODES = ("crash", "hang")
+
+#: Exit code a chaos-crashed EnvPool worker dies with (distinctive in ps/logs).
+WORKER_CRASH_EXIT_CODE = 117
+
+# Worker-fault spec, set in the parent BEFORE EnvPool forks its workers so the
+# children inherit it through fork; None means no worker fault scheduled.
+_worker_fault: Optional[Dict[str, Any]] = None
+
+
+def _chaos_cfg(cfg: Any) -> Dict[str, Any]:
+    try:
+        chaos = cfg.get("chaos") if hasattr(cfg, "get") else getattr(cfg, "chaos", None)
+    except Exception:
+        return {}
+    return dict(chaos) if chaos else {}
+
+
+def install(cfg: Any) -> None:
+    """Parse the worker-fault part of the schedule into module state (call before
+    any EnvPool fork; ``cli.run_algorithm`` does).  Validates the grammar loudly."""
+    global _worker_fault
+    chaos = _chaos_cfg(cfg)
+    _worker_fault = None
+    if not chaos:
+        return
+    sig_name = str(chaos.get("kill_signal", "SIGTERM")).upper()
+    if chaos.get("kill_at_step") is not None and sig_name not in _KILL_SIGNALS:
+        raise ValueError(f"chaos.kill_signal must be one of {sorted(_KILL_SIGNALS)}; got {sig_name!r}")
+    mode = str(chaos.get("corrupt_mode", "bitflip"))
+    if chaos.get("corrupt_ckpt_at_step") is not None and mode not in _CORRUPT_MODES:
+        raise ValueError(f"chaos.corrupt_mode must be one of {_CORRUPT_MODES}; got {mode!r}")
+    if chaos.get("worker_fault_at_step") is not None:
+        wmode = str(chaos.get("worker_fault_mode", "crash"))
+        if wmode not in _WORKER_MODES:
+            raise ValueError(f"chaos.worker_fault_mode must be one of {_WORKER_MODES}; got {wmode!r}")
+        _worker_fault = {
+            "at_step": int(chaos["worker_fault_at_step"]),
+            "mode": wmode,
+            "worker": int(chaos.get("worker_index", 0) or 0),
+            "hang_s": float(chaos.get("worker_hang_s", 3600.0)),
+        }
+
+
+def maybe_worker_fault(worker_idx: int, generation: int, step_count: int) -> None:
+    """Polled by ``rollout/worker.py`` once per step command (inherited via fork)."""
+    spec = _worker_fault
+    if spec is None or generation != 0 or worker_idx != spec["worker"]:
+        return
+    if step_count == spec["at_step"]:
+        if spec["mode"] == "crash":
+            os._exit(WORKER_CRASH_EXIT_CODE)
+        time.sleep(spec["hang_s"])  # hang: the parent's step timeout reaps us
+
+
+class ChaosMonkey:
+    """Boundary-side fault injector; inert (one attribute check) without a schedule.
+
+    ``fire(step)`` is called once per training-loop boundary by
+    :class:`~sheeprl_tpu.fault.guard.TrainingGuard` with the current policy step.
+    """
+
+    def __init__(self, cfg: Any, ckpt_dir: Optional[os.PathLike] = None, resumed: Optional[bool] = None):
+        chaos = _chaos_cfg(cfg)
+        self.seed = int(chaos.get("seed", 0) or 0)
+        self.kill_at_step = chaos.get("kill_at_step")
+        self.kill_signal = str(chaos.get("kill_signal", "SIGTERM")).upper()
+        self.corrupt_at_step = chaos.get("corrupt_ckpt_at_step")
+        self.corrupt_mode = str(chaos.get("corrupt_mode", "bitflip"))
+        self.delay_at_step = chaos.get("delay_at_step")
+        self.delay_ms = float(chaos.get("delay_ms", 500) or 0)
+        self.ckpt_dir = Path(ckpt_dir) if ckpt_dir is not None else None
+        if resumed is None:
+            try:
+                resumed = bool(cfg.get("checkpoint", {}).get("resume_from"))
+            except Exception:
+                resumed = False
+        self.resumed = bool(resumed)
+        self.enabled = any(
+            v is not None for v in (self.kill_at_step, self.corrupt_at_step, self.delay_at_step)
+        )
+        self._last_step: Optional[int] = None
+        self._fired: set = set()
+
+    def _crossed(self, kind: str, at_step: Optional[Any], step: int) -> bool:
+        """Edge trigger: True exactly once, when ``step`` first crosses ``at_step``
+        *within this run*.  A RESUMED run whose very first boundary is already past
+        the threshold crossed it in a previous life — mark fired, never re-fire
+        (that is what makes kill-at-step-N + autoresume terminate)."""
+        if at_step is None or kind in self._fired:
+            return False
+        if self._last_step is None and self.resumed and step >= int(at_step):
+            self._fired.add(kind)  # resumed past the threshold
+            return False
+        if step >= int(at_step):
+            self._fired.add(kind)
+            return True
+        return False
+
+    def fire(self, step: int) -> None:
+        if not self.enabled:
+            return
+        step = int(step)
+        if self._crossed("delay", self.delay_at_step, step):
+            _flight_recorder.record_event("chaos_delay", step=step, delay_ms=self.delay_ms)
+            _counters.bump("Fault/chaos_injected")
+            time.sleep(self.delay_ms / 1000.0)
+        if self._crossed("corrupt", self.corrupt_at_step, step):
+            _counters.bump("Fault/chaos_injected")
+            self._corrupt_latest(step)
+        if self._crossed("kill", self.kill_at_step, step):
+            _flight_recorder.record_event("chaos_kill", step=step, sig=self.kill_signal)
+            _counters.bump("Fault/chaos_injected")
+            self._kill()
+        self._last_step = step
+
+    # ------------------------------------------------------------------ faults
+    def _kill(self) -> None:
+        sig = _KILL_SIGNALS[self.kill_signal]
+        os.kill(os.getpid(), sig)
+        if sig != signal.SIGKILL:
+            # Signal delivery to the main thread is asynchronous; wait for the
+            # sticky flag so the *same* boundary handles the preemption — that
+            # determinism is what the bit-identity e2e rests on.
+            from sheeprl_tpu.fault import preemption
+
+            deadline = time.monotonic() + 5.0
+            while not preemption.preemption_requested() and time.monotonic() < deadline:
+                time.sleep(0.005)
+
+    def _corrupt_latest(self, step: int) -> None:
+        if self.ckpt_dir is None or not self.ckpt_dir.exists():
+            warnings.warn(f"chaos.corrupt_ckpt_at_step={self.corrupt_at_step}: no checkpoint dir to corrupt")
+            return
+        ckpts = sorted(
+            (p for p in self.ckpt_dir.iterdir() if p.is_dir() and p.name.startswith("ckpt_")),
+            key=lambda p: int(p.name.split("_")[1]),
+        )
+        if not ckpts:
+            warnings.warn(f"chaos.corrupt_ckpt_at_step={self.corrupt_at_step}: no published checkpoint yet")
+            return
+        target_dir = ckpts[-1]
+        victims = sorted(target_dir.glob("*.msgpack"), key=lambda p: p.stat().st_size, reverse=True)
+        if not victims:
+            victims = sorted((p for p in target_dir.iterdir() if p.is_file()), key=lambda p: p.stat().st_size, reverse=True)
+        if not victims:
+            return
+        corrupt_file(victims[0], mode=self.corrupt_mode, seed=self.seed)
+        _flight_recorder.record_event(
+            "chaos_corrupt", step=step, path=str(victims[0]), mode=self.corrupt_mode
+        )
+
+
+def corrupt_file(path: os.PathLike, mode: str = "bitflip", seed: int = 0) -> None:
+    """Deterministically damage ``path``: flip one seeded bit, or cut the file in half."""
+    path = Path(path)
+    size = path.stat().st_size
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 0))
+        return
+    if size == 0:
+        return
+    offset = seed % size
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ 0x01]))

@@ -1,0 +1,79 @@
+"""Failure classification: the supervisor's retry/fatal matrix.
+
+A relaunch loop must answer one question per death: *would running the exact same
+computation again do any good?*  The matrix (documented for operators in
+``howto/fault_tolerance.md``):
+
+===========================  ==========  =====================================
+observation                  verdict     why
+===========================  ==========  =====================================
+exit 0                       DONE        the run finished
+exit 75 / ``Preempted``      RESUME      graceful preemption: a boundary
+                                         checkpoint exists, resume immediately
+``NonFiniteError``           FATAL       a NaN/Inf is a deterministic function
+                                         of the checkpointed state: the retry
+                                         hits the same NaN at the same step
+``SignatureDriftError``      FATAL       config/code bug, deterministic
+``RecompileError``           FATAL       config/code bug, deterministic
+``KeyboardInterrupt``        FATAL       the operator asked for a stop
+anything else                RETRY       worker crash, OOM, flaky I/O, SIGKILL:
+                                         transient until proven otherwise
+                                         (bounded by ``fault.max_retries``)
+===========================  ==========  =====================================
+
+The exception *type name* comes from the flight recorder's blackbox dump
+(``blackbox/meta.json`` → ``exception.type``) when classifying a dead subprocess,
+or from the live exception object in-process — same names either way, so both
+paths share one table.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.fault.preemption import RESUMABLE_EXIT_CODE, Preempted
+
+DONE = "done"
+RESUME = "resume"  # graceful preemption: restart from the boundary checkpoint now
+RETRY = "retry"  # transient: restart with backoff, bounded by fault.max_retries
+FATAL = "fatal"  # deterministic: retrying replays the same failure
+
+#: Exception type names that make a retry pointless (see the module docstring).
+FATAL_EXCEPTIONS = frozenset(
+    {"NonFiniteError", "SignatureDriftError", "RecompileError", "KeyboardInterrupt"}
+)
+
+
+def classify_exception(exc: BaseException) -> str:
+    """In-process verdict (``fault.autoresume=True`` path)."""
+    if isinstance(exc, Preempted):
+        return RESUME
+    return FATAL if type(exc).__name__ in FATAL_EXCEPTIONS else RETRY
+
+
+def classify_exit(returncode: int, blackbox_meta: Optional[Dict[str, Any]] = None) -> str:
+    """Subprocess verdict (supervisor path): exit code first, then the blackbox."""
+    if returncode == 0:
+        return DONE
+    if returncode == RESUMABLE_EXIT_CODE:
+        return RESUME
+    exc_type = ((blackbox_meta or {}).get("exception") or {}).get("type")
+    return FATAL if exc_type in FATAL_EXCEPTIONS else RETRY
+
+
+def read_blackbox_meta(run_dir: Path) -> Optional[Dict[str, Any]]:
+    """Newest ``blackbox/meta.json`` under the run dir (any ``version_*``), or None."""
+    metas = sorted(
+        Path(run_dir).glob("**/blackbox/meta.json"),
+        key=lambda p: p.stat().st_mtime,
+        reverse=True,
+    )
+    for meta_path in metas:
+        try:
+            with open(meta_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+    return None

@@ -1,0 +1,78 @@
+"""``TrainingGuard``: the one-line safe-boundary hook every training loop calls.
+
+    guard = TrainingGuard(cfg, log_dir)            # next to CheckpointManager setup
+    ...
+    for update in ...:
+        ...train, log, periodic checkpoint...
+        guard.boundary(policy_step, save_ckpt)     # end of every update
+
+``boundary`` does two things, in order:
+
+1. fires any scheduled chaos faults that cross this step
+   (:class:`~sheeprl_tpu.fault.chaos.ChaosMonkey` — inert without a ``chaos``
+   schedule);
+2. checks the sticky preemption flag
+   (:mod:`~sheeprl_tpu.fault.preemption`); when set it calls ``save_ckpt`` —
+   the loop's own checkpoint closure, so the preemption checkpoint has exactly
+   the periodic checkpoint's contents — writes the ``PREEMPTED`` marker and
+   raises :class:`~sheeprl_tpu.fault.preemption.Preempted`.
+
+The boundary sits at the END of the update (after the periodic-checkpoint block):
+the loop's counters then describe *completed* work, so the closure saves a state
+a resume can continue from without repeating or skipping an update.
+
+Cost when nothing is scheduled and no signal arrived: two attribute checks.
+"""
+
+from __future__ import annotations
+
+import warnings
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+from sheeprl_tpu.fault import counters as _counters
+from sheeprl_tpu.fault import preemption
+from sheeprl_tpu.fault.chaos import ChaosMonkey
+from sheeprl_tpu.obs import flight_recorder as _flight_recorder
+
+
+class TrainingGuard:
+    def __init__(self, cfg: Any, log_dir: Optional[str] = None, ckpt_dir: Optional[str] = None):
+        self.cfg = cfg
+        self.log_dir = str(log_dir) if log_dir else None
+        # Every entry point keeps its checkpoints in <log_dir>/checkpoints; the
+        # chaos corrupt fault and the PREEMPTED marker's resume hint both key off it.
+        if ckpt_dir is None and log_dir:
+            ckpt_dir = str(Path(log_dir) / "checkpoints")
+        self.ckpt_dir = ckpt_dir
+        self.chaos = ChaosMonkey(cfg, ckpt_dir=ckpt_dir)
+
+    def boundary(self, step: int, save_ckpt: Optional[Callable[[], Any]] = None) -> None:
+        """Call once per update with the current policy step; ``save_ckpt`` is the
+        loop's checkpoint closure (returns the checkpoint path, or None)."""
+        if self.chaos.enabled:
+            self.chaos.fire(step)
+        if preemption.preemption_requested():
+            self._preempt(int(step), save_ckpt)
+
+    def _preempt(self, step: int, save_ckpt: Optional[Callable[[], Any]]) -> None:
+        sig = preemption.signal_name()
+        _counters.bump("Fault/preemptions")
+        _flight_recorder.record_event("preemption", step=step, signal=sig)
+        ckpt_path = None
+        if save_ckpt is not None:
+            try:
+                ckpt_path = save_ckpt()
+            except Exception as e:  # a failed goodbye checkpoint must not mask the exit
+                warnings.warn(f"preemption checkpoint at step {step} failed: {e}")
+        if ckpt_path is None and self.ckpt_dir is not None:
+            # The closure saved but returned nothing (or failed): point the marker
+            # at the newest checkpoint on disk instead of leaving it blank.
+            from sheeprl_tpu.checkpoint.manager import CheckpointManager
+
+            ckpt_path = CheckpointManager.latest_valid(self.ckpt_dir)
+        if self.log_dir:
+            preemption.write_marker(self.log_dir, step, resume_from=ckpt_path)
+        raise preemption.Preempted(
+            step, log_dir=self.log_dir, ckpt_path=str(ckpt_path) if ckpt_path else None
+        )

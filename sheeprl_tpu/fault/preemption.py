@@ -1,0 +1,184 @@
+"""Graceful preemption: SIGTERM/SIGINT → sticky flag → one final checkpoint → exit 75.
+
+Preemptible TPU fleets deliver SIGTERM with a bounded grace window (30 s on GCE
+spot VMs) before the hard kill.  The handler here does NOT checkpoint — a signal
+can land mid-dispatch, where device state is inconsistent and a blocking
+``device_get`` inside a handler can deadlock.  It only sets a *sticky flag*; every
+training loop polls the flag once per update at its safe boundary (between
+dispatches, where the checkpointable state is exactly what a periodic checkpoint
+would save) via :class:`sheeprl_tpu.fault.guard.TrainingGuard`, cuts one final
+checkpoint, writes the ``PREEMPTED`` marker and raises :class:`Preempted`, which
+``cli.run`` converts into :data:`RESUMABLE_EXIT_CODE` (75, BSD ``EX_TEMPFAIL``:
+"failure is transient, retry") — the code the supervisor treats as
+resume-immediately.
+
+A second SIGINT restores Python's default KeyboardInterrupt (an operator hammering
+Ctrl-C gets the usual abort, losing at most the boundary checkpoint); a second
+SIGTERM hard-exits with the resumable code (the platform is done waiting).
+
+``fault.grace_seconds > 0`` arms a best-effort deadline: a daemon thread hard-exits
+with the resumable code if the boundary checkpoint has not finished inside the
+window — a truncated tmp dir is invisible to resume (the atomic-rename publish
+never happened), so exiting beats being SIGKILLed mid-rename.
+
+Stdlib-only at import (the CLI installs handlers before JAX backends exist).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import warnings
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.fault import counters as _counters
+from sheeprl_tpu.obs import flight_recorder as _flight_recorder
+
+#: BSD ``EX_TEMPFAIL``: the canonical "transient failure, retry me" exit code.
+RESUMABLE_EXIT_CODE = 75
+
+#: Marker file name written next to the run's checkpoints on graceful shutdown.
+PREEMPTED_MARKER = "PREEMPTED"
+
+_flag = threading.Event()
+_installed = False
+_signal_name: Optional[str] = None
+_grace_seconds: float = 0.0
+
+
+class Preempted(Exception):
+    """Raised at a training-loop boundary after the preemption checkpoint is cut.
+
+    Carries what the resume path needs: the policy step the final checkpoint
+    covers, the checkpoint path (when the loop's save hook returned one) and the
+    run's log dir (where the ``PREEMPTED`` marker lives).
+    """
+
+    def __init__(self, step: int, log_dir: Optional[str] = None, ckpt_path: Optional[str] = None):
+        self.step = int(step)
+        self.log_dir = log_dir
+        self.ckpt_path = ckpt_path
+        super().__init__(
+            f"preempted ({_signal_name or 'requested'}) at policy step {step}; "
+            f"final checkpoint: {ckpt_path or 'none'}"
+        )
+
+
+def preemption_requested() -> bool:
+    """True once a shutdown signal arrived (or :func:`request_preemption` ran)."""
+    return _flag.is_set()
+
+
+def request_preemption(reason: str = "requested") -> None:
+    """Set the sticky flag programmatically (tests, embedding applications)."""
+    global _signal_name
+    if not _flag.is_set():
+        _signal_name = reason
+        _flag.set()
+
+
+def clear_preemption() -> None:
+    """Drop the sticky flag (in-process autoresume clears it before re-running)."""
+    global _signal_name
+    _signal_name = None
+    _flag.clear()
+
+
+def signal_name() -> Optional[str]:
+    return _signal_name
+
+
+def _arm_grace_deadline() -> None:
+    if _grace_seconds <= 0:
+        return
+
+    def deadline() -> None:
+        time.sleep(_grace_seconds)
+        if _flag.is_set():  # autoresume may have cleared it: shutdown is off
+            _flight_recorder.dump_active("preemption_grace_expired")
+            os._exit(RESUMABLE_EXIT_CODE)
+
+    threading.Thread(target=deadline, name="fault-grace-deadline", daemon=True).start()
+
+
+def _handler(signum: int, frame: Any) -> None:
+    global _signal_name
+    name = signal.Signals(signum).name
+    if _flag.is_set():
+        # Second signal: the sender is done waiting for the boundary checkpoint.
+        if signum == signal.SIGINT:
+            signal.signal(signal.SIGINT, signal.default_int_handler)
+            raise KeyboardInterrupt
+        os._exit(RESUMABLE_EXIT_CODE)
+    _signal_name = name
+    _flag.set()
+    _counters.bump("Fault/preemption_signals")
+    _flight_recorder.record_event("preemption_signal", signal=name)
+    _arm_grace_deadline()
+
+
+def install_signal_handlers(grace_seconds: float = 0.0) -> bool:
+    """Install the SIGTERM/SIGINT → sticky-flag handlers (idempotent).
+
+    Returns False without side effects when not on the main thread (signal
+    handlers can only be installed there; library embedders calling
+    ``run_algorithm`` from a worker thread keep their own handling).
+    """
+    global _installed, _grace_seconds
+    _grace_seconds = float(grace_seconds or 0.0)
+    if _installed:
+        return True
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+    except ValueError:  # not the main thread
+        return False
+    _installed = True
+    return True
+
+
+# --------------------------------------------------------------------- marker file
+def write_marker(log_dir: os.PathLike, step: int, resume_from: Optional[str] = None) -> Optional[Path]:
+    """Write ``<log_dir>/PREEMPTED`` (JSON: step, resume checkpoint, signal, time).
+
+    The marker is advisory — resume discovery always re-validates checkpoints —
+    but it lets an operator (and CI) see at a glance that the run shut down
+    *gracefully* and where it intends to pick up.  Fsynced: the marker must
+    survive the platform's hard kill that follows the grace window.
+    """
+    try:
+        path = Path(log_dir) / PREEMPTED_MARKER
+        payload = {
+            "step": int(step),
+            "resume_from": str(resume_from) if resume_from else None,
+            "signal": _signal_name,
+            "time": time.time(),
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        return path
+    except OSError as e:
+        warnings.warn(f"could not write {PREEMPTED_MARKER} marker in {log_dir}: {e}")
+        return None
+
+
+def read_marker(log_dir: os.PathLike) -> Optional[Dict[str, Any]]:
+    """Parse ``<log_dir>/PREEMPTED``; None when absent or unreadable."""
+    try:
+        with open(Path(log_dir) / PREEMPTED_MARKER) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def clear_marker(log_dir: os.PathLike) -> None:
+    try:
+        (Path(log_dir) / PREEMPTED_MARKER).unlink(missing_ok=True)
+    except OSError:
+        pass

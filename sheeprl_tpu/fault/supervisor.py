@@ -1,0 +1,186 @@
+"""Autoresume supervisor: ``python -m sheeprl_tpu.supervise <overrides>``.
+
+Wraps a training run in a relaunch loop the way a fleet scheduler would, but on one
+host and with the repo's own failure classification:
+
+1. compose the config once (no JAX touched) to pin ``run_name`` — every attempt
+   lands in the same run directory tree, so checkpoints, markers and blackboxes
+   accumulate in one place;
+2. launch ``python -m sheeprl_tpu <overrides> run_name=<pinned>`` as a subprocess
+   (plus ``checkpoint.resume_from=<latest valid>`` from the second attempt on);
+3. classify each death (:mod:`~sheeprl_tpu.fault.classify`): exit 0 → done; exit 75
+   (graceful preemption) → resume immediately; a blackbox whose exception is
+   deterministic (``NonFiniteError`` ...) → stop; anything else → retry with
+   bounded exponential backoff (``fault.backoff_s`` doubling up to
+   ``fault.backoff_max_s``, at most ``fault.max_retries`` times);
+4. resume from the newest checkpoint *that verifies* — a truncated or bit-flipped
+   latest checkpoint is skipped, not deserialized (``CheckpointManager.verify``)
+   — searching every ``version_*`` dir of the run (each attempt logs into a fresh
+   version).
+
+Children get ``SHEEPRL_TPU_FAULT_RESTARTS`` so their ``Fault/restarts`` counter
+(merged into every metric flush by ``TrainingMonitor``) reports the cumulative
+relaunch count, and ``fault.autoresume=False`` so retry accounting lives in exactly
+one place.
+
+``fault.autoresume=True`` gives the same loop in-process (``cli.run``) — enough for
+SIGTERM-style chaos drills and CI; SIGKILL/OOM survival needs this supervisor.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from sheeprl_tpu.fault import classify as _classify
+from sheeprl_tpu.fault.counters import RESTARTS_ENV_VAR
+from sheeprl_tpu.fault.preemption import RESUMABLE_EXIT_CODE
+
+
+def fault_cfg(cfg: Any) -> Dict[str, Any]:
+    try:
+        section = cfg.get("fault") if hasattr(cfg, "get") else getattr(cfg, "fault", None)
+    except Exception:
+        section = None
+    return dict(section) if section else {}
+
+
+def run_dir_for(cfg: Any) -> Path:
+    """The run's root directory (all ``version_*`` attempts live under it)."""
+    return Path(cfg.get("log_root", "logs")) / "runs" / str(cfg["root_dir"]) / str(cfg["run_name"])
+
+
+def find_resume_checkpoint(run_dir: os.PathLike) -> Optional[Path]:
+    """Newest *valid* checkpoint across every ``version_*`` of the run.
+
+    Sorted by (step, version): a later attempt resumes from the globally newest
+    step, wherever the attempt that wrote it logged.  Corrupt candidates are
+    skipped via ``CheckpointManager.verify`` — never deserialized.
+    """
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+
+    run_dir = Path(run_dir)
+    if not run_dir.exists():
+        return None
+
+    def sort_key(ckpt: Path) -> Tuple[int, int]:
+        step = int(ckpt.name.split("_")[1])
+        version_dir = ckpt.parent.parent.name  # version_N/checkpoints/ckpt_S
+        version = int(version_dir.split("_")[1]) if version_dir.startswith("version_") else -1
+        return (step, version)
+
+    candidates = sorted(run_dir.glob("version_*/checkpoints/ckpt_*"), key=sort_key, reverse=True)
+    for candidate in candidates:
+        if candidate.is_dir() and CheckpointManager.verify(candidate):
+            return candidate
+    return None
+
+
+def backoff_seconds(retries: int, base_s: float, max_s: float) -> float:
+    """Exponential backoff for retry number ``retries`` (1-based): base * 2^(n-1)."""
+    return min(float(base_s) * (2 ** max(retries - 1, 0)), float(max_s))
+
+
+def _strip_override(overrides: List[str], key: str) -> Tuple[List[str], Optional[str]]:
+    value = None
+    kept = []
+    for ov in overrides:
+        if ov.startswith(f"{key}="):
+            value = ov.split("=", 1)[1]
+        else:
+            kept.append(ov)
+    return kept, value
+
+
+def _log(msg: str) -> None:
+    print(f"[supervise] {msg}", flush=True)
+
+
+def supervise(args: Optional[List[str]] = None) -> int:
+    """The relaunch loop; returns the exit code to die with."""
+    from sheeprl_tpu.config.core import compose
+
+    overrides = list(args if args is not None else sys.argv[1:])
+    if "-m" in overrides or "--multirun" in overrides:
+        raise ValueError("the supervisor wraps a single run; use one supervisor per sweep job")
+    # The supervisor owns retry accounting: children never self-resume, and the
+    # run name is pinned so every attempt shares one run directory.
+    overrides, _ = _strip_override(overrides, "fault.autoresume")
+    cfg = compose(overrides=overrides)
+    if not cfg.get("run_name"):
+        import datetime
+
+        stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+        cfg.run_name = f"{stamp}_{cfg.get('exp_name', 'run')}_{cfg.get('seed', 0)}_supervised"
+    overrides, _ = _strip_override(overrides, "run_name")
+    f_cfg = fault_cfg(cfg)
+    max_retries = int(f_cfg.get("max_retries", 3))
+    max_preemptions = f_cfg.get("max_preemptions")  # None = resume preemptions forever
+    base_backoff = float(f_cfg.get("backoff_s", 2.0))
+    max_backoff = float(f_cfg.get("backoff_max_s", 60.0))
+    run_dir = run_dir_for(cfg)
+
+    retries = 0  # crash relaunches, bounded by fault.max_retries
+    preemptions = 0  # graceful resumes, unbounded unless fault.max_preemptions
+    resume_from: Optional[str] = cfg.get("checkpoint", {}).get("resume_from")
+    last_rc = 1
+    while True:
+        attempt_overrides = list(overrides) + [f"run_name={cfg.run_name}", "fault.autoresume=False"]
+        if resume_from:
+            attempt_overrides = [
+                ov for ov in attempt_overrides if not ov.startswith("checkpoint.resume_from=")
+            ] + [f"checkpoint.resume_from={resume_from}"]
+        env = dict(os.environ)
+        env[RESTARTS_ENV_VAR] = str(retries + preemptions)
+        attempt_start = time.time()
+        _log(
+            f"attempt {retries + preemptions + 1} (retries={retries}/{max_retries}, "
+            f"preemptions={preemptions})"
+            + (f", resuming from {resume_from}" if resume_from else "")
+        )
+        proc = subprocess.run([sys.executable, "-m", "sheeprl_tpu"] + attempt_overrides, env=env)
+        last_rc = proc.returncode
+
+        meta = None
+        if last_rc not in (0, RESUMABLE_EXIT_CODE):
+            meta = _classify.read_blackbox_meta(run_dir)
+            if meta is not None and float(meta.get("time", 0) or 0) < attempt_start - 1:
+                meta = None  # stale dump from an earlier attempt: not this death's story
+        verdict = _classify.classify_exit(last_rc, meta)
+
+        if verdict == _classify.DONE:
+            _log("run completed")
+            return 0
+        if verdict == _classify.FATAL:
+            exc = ((meta or {}).get("exception") or {}).get("type", "unknown")
+            _log(f"fatal failure ({exc}, rc={last_rc}): retrying would replay it deterministically; giving up")
+            return last_rc if last_rc else 1
+        if verdict == _classify.RESUME:
+            preemptions += 1
+            if max_preemptions is not None and preemptions > int(max_preemptions):
+                _log(f"exceeded fault.max_preemptions={max_preemptions}; giving up")
+                return last_rc
+            _log(f"graceful preemption (rc={last_rc}); resuming immediately")
+        else:  # RETRY
+            retries += 1
+            if retries > max_retries:
+                _log(f"exceeded fault.max_retries={max_retries}; giving up (rc={last_rc})")
+                return last_rc if last_rc else 1
+            delay = backoff_seconds(retries, base_backoff, max_backoff)
+            _log(f"transient failure (rc={last_rc}); retry {retries}/{max_retries} in {delay:.1f}s")
+            time.sleep(delay)
+
+        ckpt = find_resume_checkpoint(run_dir)
+        if ckpt is None:
+            _log("no valid checkpoint yet; restarting from scratch")
+            resume_from = None
+        else:
+            resume_from = str(ckpt)
+
+
+def main(args: Optional[List[str]] = None) -> None:
+    sys.exit(supervise(args))

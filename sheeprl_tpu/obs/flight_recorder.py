@@ -266,6 +266,14 @@ class FlightRecorder:
             "config_fingerprint": _config_fingerprint(self.cfg) if self.cfg is not None else None,
         }
         try:
+            # The supervisor's classification context: how many lives this run has
+            # already burned, whether a preemption signal was in flight at death.
+            from sheeprl_tpu.fault.counters import fault_metrics
+
+            meta["fault_counters"] = fault_metrics()
+        except Exception:
+            pass
+        try:
             import jax
             import jaxlib
 

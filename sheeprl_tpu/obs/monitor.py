@@ -209,16 +209,20 @@ class TrainingMonitor:
     def log_metrics(self, logger, metrics: Dict[str, float], step: int) -> None:
         """Merge the monitor's metrics and forward to the logger inside a log span.
 
-        Runs two things regardless of ``obs.enabled``: (a) folds the named-timer
+        Runs three things regardless of ``obs.enabled``: (a) folds the named-timer
         registry into the flush, so every loop instrumented with ``monitor.phase``
         / ``with timer(...)`` reports the ``Time/phase_*`` wall-clock breakdown for
-        free, and (b) records a ``metric_flush`` event (with a Health/Loss
+        free, (b) folds in the ``Fault/*`` counters (``sheeprl_tpu/fault``) — empty
+        for a healthy run, the preemption/restart/fallback trail for a supervised
+        one — and (c) records a ``metric_flush`` event (with a Health/Loss
         snapshot) on the flight recorder — the learning-dynamics trail a blackbox
         dump is read by.
         """
+        from sheeprl_tpu.fault.counters import fault_metrics
         from sheeprl_tpu.utils.timer import timer as _timer
 
         metrics.update(_timer.to_dict(reset=True))
+        metrics.update(fault_metrics())
         if _flight_recorder.get_active() is not None:
             snapshot = {
                 k: metrics[k]

@@ -29,6 +29,65 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 _distributed_initialized = False
 
+#: Default multi-host barrier timeout (seconds); override with
+#: ``SHEEPRL_TPU_BARRIER_TIMEOUT_S`` (<=0 disables the timeout entirely).
+DEFAULT_BARRIER_TIMEOUT_S = 600.0
+
+
+class BarrierTimeoutError(RuntimeError):
+    """A multi-host barrier did not complete in time: a peer process is likely dead
+    (preempted, OOM-killed, crashed before reaching the barrier).  Raised instead of
+    hanging forever so the supervisor can classify and relaunch the run."""
+
+
+def _wait_with_timeout(fn, name: str, timeout_s: float) -> None:
+    """Run blocking ``fn`` on a side thread and give up after ``timeout_s``.
+
+    ``sync_global_devices`` has no cancellation API, so the orphaned thread is left
+    to die with the process — acceptable, because the only caller reaction to a
+    barrier timeout is to tear the process down and let the supervisor relaunch."""
+    import threading
+
+    result: Dict[str, Any] = {}
+
+    def target() -> None:
+        try:
+            fn()
+            result["ok"] = True
+        except Exception as e:  # pragma: no cover - backend-specific failures
+            result["error"] = e
+
+    t = threading.Thread(target=target, name=f"barrier-{name}", daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise BarrierTimeoutError(
+            f"multi-host barrier {name!r} timed out after {timeout_s:.0f}s: a peer "
+            "process is likely dead or preempted (this rank would otherwise hang "
+            "forever). Restart the run from the latest checkpoint — "
+            "`python -m sheeprl_tpu.supervise` automates this — or raise/disable the "
+            "timeout with SHEEPRL_TPU_BARRIER_TIMEOUT_S (<=0 disables)."
+        )
+    if "error" in result:
+        raise result["error"]
+
+
+def sync_global_devices_with_timeout(name: str, timeout_s: Optional[float] = None) -> None:
+    """``multihost_utils.sync_global_devices`` with a deadline and an actionable
+    error.  No-op in single-process runs; the env var
+    ``SHEEPRL_TPU_BARRIER_TIMEOUT_S`` overrides the default (read per call, so a
+    long planned stall — e.g. one rank compiling — can widen it mid-run)."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("SHEEPRL_TPU_BARRIER_TIMEOUT_S", DEFAULT_BARRIER_TIMEOUT_S))
+    if timeout_s <= 0:
+        multihost_utils.sync_global_devices(name)
+        return
+    _wait_with_timeout(lambda: multihost_utils.sync_global_devices(name), name, timeout_s)
+
 
 def shard_map_compat(fn, mesh, in_specs, out_specs):
     """``jax.shard_map`` moved out of ``jax.experimental`` only in newer releases;
@@ -305,10 +364,7 @@ class MeshContext:
         return multihost_utils.broadcast_one_to_all(obj)
 
     def barrier(self) -> None:
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices("sheeprl_tpu_barrier")
+        sync_global_devices_with_timeout("sheeprl_tpu_barrier")
 
     @contextlib.contextmanager
     def default_mesh(self):

@@ -64,7 +64,7 @@ class _WorkerCrashed(Exception):
 class _Worker:
     """Parent-side handle: process + pipe + env-index range + restart generation."""
 
-    __slots__ = ("idx", "first", "env_fns", "proc", "conn", "generation", "failed")
+    __slots__ = ("idx", "first", "env_fns", "proc", "conn", "generation", "failed", "restarts", "timeouts", "crashes")
 
     def __init__(self, idx: int, first: int, env_fns: Sequence[Callable]):
         self.idx = idx
@@ -74,6 +74,10 @@ class _Worker:
         self.conn = None
         self.generation = 0
         self.failed = False
+        # Per-worker fault ledger, quoted in the RolloutAbortError post-mortem.
+        self.restarts = 0
+        self.timeouts = 0
+        self.crashes = 0
 
     @property
     def num_envs(self) -> int:
@@ -182,7 +186,7 @@ class EnvPool(VectorEnv):
         w.failed = False
         w.proc = self._ctx.Process(
             target=worker_entry,
-            args=(w.idx, w.first, w.env_fns, self._slabs, child_conn, self.heartbeat_interval_s),
+            args=(w.idx, w.first, w.env_fns, self._slabs, child_conn, self.heartbeat_interval_s, w.generation),
             name=f"envpool-worker-{w.idx}-gen{w.generation}",
             daemon=True,
         )
@@ -234,6 +238,23 @@ class EnvPool(VectorEnv):
                 code = None if w.proc is None else w.proc.exitcode
                 raise _WorkerCrashed(f"worker {w.idx} died (exitcode={code})")
 
+    def _abort_post_mortem(self) -> str:
+        """Per-worker fault ledger for the RolloutAbortError message: WHY the budget
+        ran out, without the operator having to dig through metrics or event logs."""
+        ages = self.heartbeat_ages()
+        rows = []
+        for w in self._workers:
+            age = ages[w.idx] if w.idx < len(ages) else float("inf")
+            age_s = f"{age:.1f}s" if np.isfinite(age) else "never"
+            rows.append(
+                f"worker {w.idx}: restarts={w.restarts} timeouts={w.timeouts} "
+                f"crashes={w.crashes} last_heartbeat={age_s} ago"
+            )
+        return (
+            f"totals: restarts={self._total_restarts} timeouts={self._timeout_restarts} "
+            f"crashes={self._crash_restarts} over {self._step_count} steps; " + "; ".join(rows)
+        )
+
     def heartbeat_ages(self) -> np.ndarray:
         """Seconds since each worker's last heartbeat stamp (inf before first beat)."""
         stamps = np.array(self._views.heartbeats, dtype=np.float64)
@@ -260,14 +281,16 @@ class EnvPool(VectorEnv):
                     budget=self.max_restarts,
                 )
                 if self._total_restarts > self.max_restarts:
+                    post_mortem = self._abort_post_mortem()
                     self.close(terminate=True)
                     flight_recorder.record_event(
                         "rollout_abort", worker=w.idx, reason=reason, restarts=self._total_restarts
                     )
                     raise RolloutAbortError(
                         f"EnvPool exceeded max_restarts={self.max_restarts} "
-                        f"(last failure: worker {w.idx}: {reason})"
+                        f"(last failure: worker {w.idx}: {reason}); {post_mortem}"
                     )
+                w.restarts += 1
                 warnings.warn(f"EnvPool restarting worker {w.idx} ({reason}); restart {self._total_restarts}/{self.max_restarts}")
                 self._kill(w)
                 if self.restart_backoff_s > 0:
@@ -359,10 +382,12 @@ class EnvPool(VectorEnv):
                     continue
                 except _WorkerTimeout as e:
                     self._timeout_restarts += 1
+                    w.timeouts += 1
                     failure = str(e)
                     flight_recorder.record_event("rollout_timeout", worker=w.idx, error=failure)
                 except _WorkerCrashed as e:
                     self._crash_restarts += 1
+                    w.crashes += 1
                     failure = str(e)
                     flight_recorder.record_event("rollout_crash", worker=w.idx, error=failure)
             self._restart(w, failure)

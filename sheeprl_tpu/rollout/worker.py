@@ -48,8 +48,15 @@ def worker_entry(
     slabs,
     conn,
     heartbeat_interval_s: float,
+    generation: int = 0,
 ) -> None:
+    # Chaos harness hook (stdlib-only module): the worker-fault spec set in the
+    # parent BEFORE the fork rides into this process; the poll below is a no-op
+    # (one global load) unless a fault is scheduled for this worker+generation.
+    from sheeprl_tpu.fault import chaos as _chaos
+
     envs: List[Any] = []
+    step_count = 0
     try:
         views = slabs.views()
         _start_heartbeat(views.heartbeats, worker_idx, max(heartbeat_interval_s, 0.05))
@@ -71,6 +78,8 @@ def worker_entry(
                     payloads.append((gi, [info] if info else []))
                 conn.send(("ok", payloads))
             elif cmd == "step":
+                step_count += 1
+                _chaos.maybe_worker_fault(worker_idx, generation, step_count)
                 payloads = []
                 for j, env in enumerate(envs):
                     gi = first_env_idx + j

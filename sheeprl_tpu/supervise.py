@@ -1,0 +1,11 @@
+"""``python -m sheeprl_tpu.supervise exp=... [overrides]``: autoresume supervisor.
+
+Relaunches a crashed or preempted training run from the latest *valid* checkpoint
+with bounded exponential-backoff retries; see ``sheeprl_tpu/fault/supervisor.py``
+and ``howto/fault_tolerance.md``.
+"""
+
+from sheeprl_tpu.fault.supervisor import main
+
+if __name__ == "__main__":
+    main()

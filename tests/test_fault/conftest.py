@@ -1,0 +1,23 @@
+"""Shared state hygiene for the fault-tolerance tests.
+
+The fault layer is deliberately process-global (sticky preemption flag,
+counters, chaos worker-fault spec) — these fixtures guarantee no test leaks
+that state into its neighbors.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from sheeprl_tpu.fault import chaos, counters, preemption
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    preemption.clear_preemption()
+    counters.reset()
+    chaos.install({})
+    yield
+    preemption.clear_preemption()
+    counters.reset()
+    chaos.install({})

@@ -1,0 +1,148 @@
+"""Chaos harness: schedule grammar, edge triggers, fault effects."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault import chaos, preemption
+from sheeprl_tpu.fault.chaos import ChaosMonkey, corrupt_file
+from sheeprl_tpu.fault.counters import fault_metrics
+from sheeprl_tpu.rollout import EnvPool
+from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+
+
+def _cfg(**kw) -> dict:
+    return {"chaos": kw}
+
+
+# ----------------------------------------------------------------- grammar
+def test_install_rejects_bad_kill_signal():
+    with pytest.raises(ValueError, match="chaos.kill_signal must be one of"):
+        chaos.install(_cfg(kill_at_step=5, kill_signal="SIGSTOP"))
+
+
+def test_install_rejects_bad_corrupt_mode():
+    with pytest.raises(ValueError, match="chaos.corrupt_mode must be one of"):
+        chaos.install(_cfg(corrupt_ckpt_at_step=5, corrupt_mode="shred"))
+
+
+def test_install_rejects_bad_worker_fault_mode():
+    with pytest.raises(ValueError, match="chaos.worker_fault_mode must be one of"):
+        chaos.install(_cfg(worker_fault_at_step=5, worker_fault_mode="explode"))
+
+
+# ------------------------------------------------------------- edge trigger
+def test_disabled_monkey_is_inert():
+    monkey = ChaosMonkey(_cfg())
+    assert not monkey.enabled
+    monkey.fire(10**9)
+
+
+def test_delay_fires_exactly_once_on_crossing(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(chaos.time, "sleep", sleeps.append)
+    monkey = ChaosMonkey(_cfg(delay_at_step=10, delay_ms=250))
+    monkey.fire(5)
+    assert not sleeps
+    monkey.fire(12)  # crosses the threshold
+    assert sleeps == [0.25]
+    monkey.fire(20)  # edge trigger: never again
+    assert sleeps == [0.25]
+    assert fault_metrics().get("Fault/chaos_injected") == 1.0
+
+
+def test_resumed_run_past_threshold_never_fires(monkeypatch):
+    """A run resumed past the threshold crossed it in a previous life — the
+    fault is marked fired without firing (kill + autoresume terminates)."""
+    sleeps = []
+    monkeypatch.setattr(chaos.time, "sleep", sleeps.append)
+    monkey = ChaosMonkey(_cfg(delay_at_step=10, delay_ms=250), resumed=True)
+    monkey.fire(32)  # first boundary of the resumed run, already past 10
+    monkey.fire(48)
+    assert not sleeps
+
+
+def test_fresh_run_past_threshold_does_fire(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(chaos.time, "sleep", sleeps.append)
+    monkey = ChaosMonkey(_cfg(delay_at_step=10, delay_ms=250), resumed=False)
+    monkey.fire(32)
+    assert sleeps == [0.25]
+
+
+def test_kill_sigterm_sets_sticky_preemption_flag():
+    """The SIGTERM kill waits for the sticky flag so the same boundary that
+    fired the fault handles the graceful shutdown."""
+    assert preemption.install_signal_handlers()
+    monkey = ChaosMonkey(_cfg(kill_at_step=4, kill_signal="SIGTERM"))
+    monkey.fire(4)
+    assert preemption.preemption_requested()
+    assert preemption.signal_name() == "SIGTERM"
+
+
+def test_corrupt_latest_invalidates_newest_checkpoint(tmp_path):
+    manager = CheckpointManager(tmp_path / "checkpoints")
+    state = {"params": {"w": np.zeros((4, 4), np.float32)}}
+    ckpt1 = manager.save(10, state)
+    ckpt2 = manager.save(20, state)
+    monkey = ChaosMonkey(_cfg(corrupt_ckpt_at_step=15), ckpt_dir=manager.ckpt_dir)
+    monkey.fire(20)
+    assert not CheckpointManager.verify(ckpt2)
+    assert CheckpointManager.latest_valid(manager.ckpt_dir) == ckpt1
+
+
+# ------------------------------------------------------------- corrupt_file
+def test_corrupt_file_bitflip_is_deterministic(tmp_path):
+    a = tmp_path / "a.bin"
+    b = tmp_path / "b.bin"
+    payload = bytes(range(256))
+    a.write_bytes(payload)
+    b.write_bytes(payload)
+    corrupt_file(a, mode="bitflip", seed=7)
+    corrupt_file(b, mode="bitflip", seed=7)
+    assert a.read_bytes() == b.read_bytes() != payload
+    # exactly one byte differs, by exactly one bit
+    diff = [(x, y) for x, y in zip(a.read_bytes(), payload) if x != y]
+    assert len(diff) == 1 and diff[0][0] ^ diff[0][1] == 0x01
+
+
+def test_corrupt_file_truncate_halves(tmp_path):
+    f = tmp_path / "f.bin"
+    f.write_bytes(b"x" * 100)
+    corrupt_file(f, mode="truncate")
+    assert f.stat().st_size == 50
+
+
+# ------------------------------------------------------------ worker faults
+def test_maybe_worker_fault_is_noop_for_other_slots_and_generations():
+    chaos.install(_cfg(worker_fault_at_step=1, worker_fault_mode="crash", worker_index=0))
+    # Wrong worker / wrong generation / wrong step: none of these may os._exit.
+    chaos.maybe_worker_fault(worker_idx=1, generation=0, step_count=1)
+    chaos.maybe_worker_fault(worker_idx=0, generation=1, step_count=1)
+    chaos.maybe_worker_fault(worker_idx=0, generation=0, step_count=2)
+
+
+def test_worker_crash_fault_rides_fork_and_pool_restarts(recwarn):
+    """The spec installed in the parent before the fork crashes worker 0 at its
+    2nd step command; the pool restarts it and the replacement (generation 1)
+    runs clean."""
+    chaos.install(_cfg(worker_fault_at_step=2, worker_fault_mode="crash", worker_index=0))
+    try:
+        thunks = [lambda: DiscreteDummyEnv(n_steps=32)]
+        pool = EnvPool(thunks, num_workers=1, step_timeout_s=30.0, max_restarts=2, restart_backoff_s=0.0)
+        try:
+            pool.reset(seed=0)
+            pool.step(np.zeros(1, np.int64))
+            obs, rew, term, trunc, info = pool.step(np.zeros(1, np.int64))  # chaos crash
+            assert trunc[0] and info["rollout_restart"][0]
+            m = pool.rollout_metrics()
+            assert m["Rollout/worker_restarts"] == 1.0
+            assert m["Rollout/worker_crashes"] == 1.0
+            # generation 1 is immune: stepping continues
+            pool.step(np.zeros(1, np.int64))
+        finally:
+            pool.close(terminate=True)
+    finally:
+        chaos.install({})

@@ -1,0 +1,93 @@
+"""Failure classification matrix and the supervisor's pure helpers."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault import classify
+from sheeprl_tpu.fault.chaos import corrupt_file
+from sheeprl_tpu.fault.preemption import RESUMABLE_EXIT_CODE, Preempted
+from sheeprl_tpu.fault.supervisor import (
+    _strip_override,
+    backoff_seconds,
+    find_resume_checkpoint,
+)
+from sheeprl_tpu.analysis.strict import NonFiniteError
+
+
+# ------------------------------------------------------------ classification
+def test_classify_exception_matrix():
+    assert classify.classify_exception(Preempted(5)) == classify.RESUME
+    assert classify.classify_exception(NonFiniteError("loss is NaN")) == classify.FATAL
+    assert classify.classify_exception(KeyboardInterrupt()) == classify.FATAL
+    assert classify.classify_exception(ValueError("flaky")) == classify.RETRY
+    assert classify.classify_exception(OSError("stale NFS handle")) == classify.RETRY
+
+
+def test_classify_exit_matrix():
+    assert classify.classify_exit(0) == classify.DONE
+    assert classify.classify_exit(RESUMABLE_EXIT_CODE) == classify.RESUME
+    assert classify.classify_exit(1) == classify.RETRY
+    assert classify.classify_exit(-9) == classify.RETRY  # SIGKILL: transient
+    fatal_meta = {"exception": {"type": "NonFiniteError"}}
+    assert classify.classify_exit(1, fatal_meta) == classify.FATAL
+    retry_meta = {"exception": {"type": "RuntimeError"}}
+    assert classify.classify_exit(1, retry_meta) == classify.RETRY
+
+
+def test_read_blackbox_meta_picks_newest_and_survives_garbage(tmp_path):
+    assert classify.read_blackbox_meta(tmp_path) is None
+    old = tmp_path / "version_0" / "blackbox"
+    new = tmp_path / "version_1" / "blackbox"
+    old.mkdir(parents=True)
+    new.mkdir(parents=True)
+    (old / "meta.json").write_text(json.dumps({"exception": {"type": "Old"}}))
+    (new / "meta.json").write_text(json.dumps({"exception": {"type": "New"}}))
+    import os
+    os.utime(old / "meta.json", (1, 1))
+    meta = classify.read_blackbox_meta(tmp_path)
+    assert meta["exception"]["type"] == "New"
+    (new / "meta.json").write_text("not json{")
+    meta = classify.read_blackbox_meta(tmp_path)
+    assert meta["exception"]["type"] == "Old"
+
+
+# ----------------------------------------------------------------- backoff
+def test_backoff_doubles_and_caps():
+    assert backoff_seconds(1, 2.0, 60.0) == 2.0
+    assert backoff_seconds(2, 2.0, 60.0) == 4.0
+    assert backoff_seconds(3, 2.0, 60.0) == 8.0
+    assert backoff_seconds(10, 2.0, 60.0) == 60.0
+
+
+def test_strip_override():
+    kept, value = _strip_override(["a=1", "fault.autoresume=True", "b=2"], "fault.autoresume")
+    assert kept == ["a=1", "b=2"]
+    assert value == "True"
+    kept, value = _strip_override(["a=1"], "run_name")
+    assert kept == ["a=1"] and value is None
+
+
+# -------------------------------------------------- resume-ckpt discovery
+def _publish(run_dir, version: int, step: int):
+    manager = CheckpointManager(run_dir / f"version_{version}" / "checkpoints")
+    return manager.save(step, {"params": {"w": np.zeros(4, np.float32)}})
+
+
+def test_find_resume_checkpoint_newest_step_across_versions(tmp_path):
+    assert find_resume_checkpoint(tmp_path) is None
+    _publish(tmp_path, 0, 10)
+    _publish(tmp_path, 0, 20)
+    newest = _publish(tmp_path, 1, 30)
+    assert find_resume_checkpoint(tmp_path) == newest
+
+
+def test_find_resume_checkpoint_skips_corrupt_newest(tmp_path):
+    older = _publish(tmp_path, 0, 20)
+    newest = _publish(tmp_path, 1, 30)
+    corrupt_file(newest / "params.msgpack", mode="truncate")
+    assert find_resume_checkpoint(tmp_path) == older

@@ -1,0 +1,80 @@
+"""End-to-end chaos drills (slow tier; the fast-tier equivalent runs as a CI
+workflow step, see ``cpu-tests.yaml`` "Chaos preemption + autoresume smoke").
+
+The acceptance contract: SIGTERM mid-run + autoresume reaches final params
+BIT-IDENTICAL to an uninterrupted run, and a bit-flipped latest checkpoint
+resumes from the previous valid one instead of deserializing garbage.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.fault.chaos import corrupt_file
+from tests.test_algos.test_anakin import PPO_ANAKIN_ARGS, SAC_ANAKIN_ARGS
+
+pytestmark = pytest.mark.slow
+
+# Mirrors the CI workflow smoke ("Chaos preemption + autoresume smoke"): a tiny
+# deterministic run with checkpoints every 16 of 64 total policy steps.
+_E2E = [
+    "algo.total_steps=64",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "mesh.devices=1",
+    "checkpoint.every=16",
+    "checkpoint.save_last=True",
+    "metric.log_every=16",
+    "buffer.memmap=False",
+    "algo.run_test=False",
+]
+
+
+def _final_carry(root: Path) -> Path:
+    """The highest-step (then newest) ``carry.msgpack`` under a run tree."""
+    candidates = sorted(
+        root.rglob("ckpt_*/carry.msgpack"),
+        key=lambda p: (int(p.parent.name.split("_")[1]), p.stat().st_mtime),
+    )
+    assert candidates, f"no checkpoints under {root}"
+    return candidates[-1]
+
+
+def _args(base, tmp_path, sub, extra=()):
+    return base + _E2E + [f"log_root={tmp_path / sub}"] + list(extra)
+
+
+def test_ppo_anakin_kill_autoresume_bit_identical(tmp_path):
+    run(_args(PPO_ANAKIN_ARGS, tmp_path, "killed", ["chaos.kill_at_step=32", "fault.autoresume=True"]))
+    run(_args(PPO_ANAKIN_ARGS, tmp_path, "clean"))
+    killed = _final_carry(tmp_path / "killed")
+    clean = _final_carry(tmp_path / "clean")
+    assert int(killed.parent.name.split("_")[1]) == 64
+    assert killed.read_bytes() == clean.read_bytes(), (
+        "kill-at-32 + autoresume diverged from the uninterrupted run"
+    )
+    # the interrupted attempt left its PREEMPTED marker behind
+    assert list((tmp_path / "killed").rglob("PREEMPTED")), "no PREEMPTED marker written"
+
+
+def test_sac_anakin_kill_autoresume_bit_identical(tmp_path):
+    extra = ["chaos.kill_at_step=32", "fault.autoresume=True"]
+    run(_args(SAC_ANAKIN_ARGS, tmp_path, "killed", extra))
+    run(_args(SAC_ANAKIN_ARGS, tmp_path, "clean"))
+    killed = _final_carry(tmp_path / "killed")
+    clean = _final_carry(tmp_path / "clean")
+    assert killed.read_bytes() == clean.read_bytes(), (
+        "SAC kill-at-32 + autoresume diverged from the uninterrupted run"
+    )
+
+
+def test_ppo_anakin_resume_falls_back_past_bitflipped_checkpoint(tmp_path):
+    run(_args(PPO_ANAKIN_ARGS, tmp_path, "run"))
+    latest = _final_carry(tmp_path / "run").parent
+    assert latest.name == "ckpt_64"
+    corrupt_file(latest / "carry.msgpack", mode="bitflip", seed=0)
+    # Resuming from the damaged checkpoint must fall back to ckpt_48 and finish.
+    run(_args(PPO_ANAKIN_ARGS, tmp_path, "run", [f"checkpoint.resume_from={latest}"]))

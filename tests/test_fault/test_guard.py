@@ -1,0 +1,61 @@
+"""TrainingGuard: the safe-boundary hook every training loop calls."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.checkpoint.manager import CheckpointManager
+from sheeprl_tpu.fault import preemption
+from sheeprl_tpu.fault.counters import fault_metrics
+from sheeprl_tpu.fault.guard import TrainingGuard
+
+
+def _cfg(**chaos) -> dict:
+    return {"chaos": chaos, "checkpoint": {}, "fault": {}}
+
+
+def test_boundary_is_noop_without_flag_or_schedule(tmp_path):
+    guard = TrainingGuard(_cfg(), str(tmp_path))
+    guard.boundary(100, lambda: (_ for _ in ()).throw(AssertionError("must not save")))
+
+
+def test_boundary_preempts_saves_and_writes_marker(tmp_path):
+    guard = TrainingGuard(_cfg(), str(tmp_path))
+    manager = CheckpointManager(tmp_path / "checkpoints")
+    saved = []
+
+    def save_ckpt():
+        path = manager.save(64, {"params": {"w": np.zeros(3, np.float32)}, "policy_step": 64})
+        saved.append(path)
+        return path
+
+    preemption.request_preemption("SIGTERM")
+    with pytest.raises(preemption.Preempted) as exc_info:
+        guard.boundary(64, save_ckpt)
+    assert saved, "the boundary must cut the goodbye checkpoint"
+    assert exc_info.value.step == 64
+    assert exc_info.value.ckpt_path == str(saved[0])
+    marker = preemption.read_marker(tmp_path)
+    assert marker is not None and marker["step"] == 64
+    assert marker["resume_from"] == str(saved[0])
+    assert fault_metrics().get("Fault/preemptions") == 1.0
+
+
+def test_boundary_save_failure_falls_back_to_latest_valid(tmp_path, recwarn):
+    """A failed goodbye checkpoint must not mask the graceful exit: the marker
+    points at the newest valid checkpoint already on disk."""
+    manager = CheckpointManager(tmp_path / "checkpoints")
+    existing = manager.save(32, {"params": {"w": np.zeros(3, np.float32)}})
+    guard = TrainingGuard(_cfg(), str(tmp_path))
+
+    def failing_save():
+        raise OSError("disk full")
+
+    preemption.request_preemption("SIGTERM")
+    with pytest.raises(preemption.Preempted) as exc_info:
+        guard.boundary(40, failing_save)
+    assert exc_info.value.ckpt_path == str(existing)
+    assert any("preemption checkpoint" in str(w.message) for w in recwarn.list)
+    marker = preemption.read_marker(tmp_path)
+    assert marker["resume_from"] == str(existing)

@@ -1,0 +1,59 @@
+"""Preemption signal layer: sticky flag, real-signal delivery, marker file."""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+from sheeprl_tpu.fault import preemption
+from sheeprl_tpu.fault.counters import fault_metrics
+
+
+def test_request_and_clear_preemption():
+    assert not preemption.preemption_requested()
+    preemption.request_preemption("test")
+    assert preemption.preemption_requested()
+    assert preemption.signal_name() == "test"
+    preemption.clear_preemption()
+    assert not preemption.preemption_requested()
+    assert preemption.signal_name() is None
+
+
+def test_real_sigterm_sets_sticky_flag_only():
+    """The handler does no work in signal context: one SIGTERM just sets the
+    flag (and bumps the counter) — the boundary does the rest."""
+    assert preemption.install_signal_handlers()
+    os.kill(os.getpid(), signal.SIGTERM)
+    deadline = time.monotonic() + 5.0
+    while not preemption.preemption_requested() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert preemption.preemption_requested()
+    assert preemption.signal_name() == "SIGTERM"
+    assert fault_metrics().get("Fault/preemption_signals") == 1.0
+
+
+def test_preempted_exception_carries_resume_context():
+    exc = preemption.Preempted(42, log_dir="/tmp/run", ckpt_path="/tmp/run/ckpt_42")
+    assert exc.step == 42
+    assert exc.log_dir == "/tmp/run"
+    assert exc.ckpt_path == "/tmp/run/ckpt_42"
+    assert "42" in str(exc)
+
+
+def test_marker_round_trip(tmp_path):
+    preemption.request_preemption("SIGTERM")
+    path = preemption.write_marker(tmp_path, 128, resume_from=str(tmp_path / "ckpt_128"))
+    assert path is not None and path.name == preemption.PREEMPTED_MARKER
+    marker = preemption.read_marker(tmp_path)
+    assert marker["step"] == 128
+    assert marker["resume_from"].endswith("ckpt_128")
+    assert marker["signal"] == "SIGTERM"
+    preemption.clear_marker(tmp_path)
+    assert preemption.read_marker(tmp_path) is None
+
+
+def test_read_marker_absent_or_garbage(tmp_path):
+    assert preemption.read_marker(tmp_path) is None
+    (tmp_path / preemption.PREEMPTED_MARKER).write_text("not json{")
+    assert preemption.read_marker(tmp_path) is None

@@ -1,0 +1,39 @@
+"""Barrier timeouts: fail loudly instead of hanging when a peer host dies."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from sheeprl_tpu.parallel.mesh import (
+    BarrierTimeoutError,
+    _wait_with_timeout,
+    sync_global_devices_with_timeout,
+)
+
+
+def test_wait_with_timeout_raises_on_stall():
+    with pytest.raises(BarrierTimeoutError, match="supervise"):
+        _wait_with_timeout(lambda: time.sleep(5), "ckpt_sync", 0.2)
+
+
+def test_wait_with_timeout_error_is_actionable():
+    with pytest.raises(BarrierTimeoutError, match="SHEEPRL_TPU_BARRIER_TIMEOUT_S"):
+        _wait_with_timeout(lambda: time.sleep(5), "ckpt_sync", 0.2)
+
+
+def test_wait_with_timeout_fast_fn_passes():
+    _wait_with_timeout(lambda: None, "noop", 5.0)
+
+
+def test_wait_with_timeout_propagates_fn_error():
+    def boom():
+        raise RuntimeError("collective failed")
+
+    with pytest.raises(RuntimeError, match="collective failed"):
+        _wait_with_timeout(boom, "boom", 5.0)
+
+
+def test_sync_is_noop_single_process():
+    sync_global_devices_with_timeout("unit_test", timeout_s=0.1)

@@ -58,14 +58,20 @@ def test_watchdog_restarts_crashed_worker(recwarn):
 
 
 def test_max_restarts_budget_aborts(recwarn):
-    """Past the restart budget the pool tears down and raises RolloutAbortError."""
+    """Past the restart budget the pool tears down and raises RolloutAbortError
+    whose message quotes a per-worker post-mortem (restart/timeout/crash counts
+    and heartbeat age) — the flaky worker is identifiable from the traceback."""
     thunks = [lambda: CrashingEnv(crash_at=1, n_steps=32)]
     pool = EnvPool(thunks, num_workers=1, step_timeout_s=30.0, max_restarts=0, restart_backoff_s=0.0)
     pool.reset(seed=0)
-    with pytest.raises(RolloutAbortError):
+    with pytest.raises(RolloutAbortError) as exc_info:
         pool.step(np.zeros(1, np.int64))
     assert pool.closed
     assert all(w.proc is None or not w.proc.is_alive() for w in pool._workers)
+    msg = str(exc_info.value)
+    assert "totals: restarts=" in msg
+    assert "worker 0: restarts=" in msg
+    assert "last_heartbeat" in msg
 
 
 def test_restart_reseeds_with_generation_offset(recwarn):
