@@ -319,6 +319,29 @@ def bench_fault() -> list:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_sebulba() -> list:
+    """Sebulba multi-process topology rows (``benchmarks/sebulba_bench.py``):
+    2-actor acting throughput vs 1 actor and the thread-decoupled baseline,
+    plus the learner's grad-steps/s while blocks stream over the transport.
+    Spawns 4 short subprocess runs: steady-state trace rates for the sebulba
+    variants, a two-budget wall delta for the thread baseline (startup and
+    compile cancel either way).  Set ``BENCH_SEBULBA=0`` to skip."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    try:
+        import sebulba_bench
+    finally:
+        sys.path.pop(0)
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        sebulba_bench.main([])
+    return [json.loads(line) for line in buf.getvalue().splitlines() if line.strip()]
+
+
 def bench_ir_audit() -> dict:
     """Wall-clock of the full ``jaxlint-ir`` audit (``sheeprl_tpu/analysis/ir``):
     AOT-lower + compile + rule-check every entry point's jitted update and both
@@ -367,6 +390,14 @@ def main() -> None:
                 print(json.dumps(row))
         except Exception as exc:
             print(json.dumps({"metric": "anakin_cartpole_steps_per_sec", "error": str(exc)[:200]}))
+    # Sebulba multi-process topology rows (ISSUE-13): BENCH_SEBULBA=0 skips
+    # (it spawns a fleet of short subprocess runs, the longest bench section).
+    if os.environ.get("BENCH_SEBULBA", "1") != "0":
+        try:
+            for row in bench_sebulba():
+                print(json.dumps(row))
+        except Exception as exc:
+            print(json.dumps({"metric": "sebulba_env_steps_per_sec", "error": str(exc)[:200]}))
     # Fault-tolerance cost rows (ISSUE-10): checkpoint save + verified restore.
     if os.environ.get("BENCH_FAULT", "1") != "0":
         try:

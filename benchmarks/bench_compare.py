@@ -36,9 +36,10 @@ from typing import Dict, List, Optional, Tuple
 
 _LOWER_BETTER_HINTS = ("ms", "latency", "time", "seconds")
 # Explicit direction pins beat the unit-text heuristic: every anakin_* row
-# (benchmarks/anakin_bench.py) is a throughput — higher is better — regardless
-# of what its unit string mentions...
-_HIGHER_BETTER_PREFIXES = ("anakin_",)
+# (benchmarks/anakin_bench.py) and sebulba_* row (benchmarks/sebulba_bench.py)
+# is a throughput — higher is better — regardless of what its unit string
+# mentions...
+_HIGHER_BETTER_PREFIXES = ("anakin_", "sebulba_")
 # ...EXCEPT the compile-cache wall-clock row, which is a duration: exact-name
 # pins win over the prefix pin.
 _LOWER_BETTER_METRICS = ("anakin_compile_seconds", "checkpoint_save_seconds", "resume_restore_seconds")

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import os
 import queue
+import sys
 import threading
 import time
 from pathlib import Path
@@ -44,6 +45,9 @@ from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.distributed.placement import placement_from_cfg
+from sheeprl_tpu.distributed.publish import evict_and_put, make_stamp, staleness_steps
+from sheeprl_tpu.distributed.transport import maybe_digest
 from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -55,6 +59,19 @@ from sheeprl_tpu.utils.utils import polynomial_decay
 
 @register_algorithm(name="ppo_decoupled", decoupled=True)
 def main(ctx, cfg) -> None:
+    # Sebulba (distributed.mode=sebulba): the player/learner threads below become
+    # placed processes — children land in sebulba.run, the launcher role places
+    # them (howto/sebulba.md).
+    spec = placement_from_cfg(cfg)
+    if spec.is_sebulba:
+        if spec.role == "launcher":
+            from sheeprl_tpu.distributed import launcher
+
+            raise SystemExit(launcher.launch(sys.argv[1:]))
+        from sheeprl_tpu.distributed import sebulba
+
+        return sebulba.run(ctx, cfg, spec, algo="ppo")
+
     rank = ctx.process_index
     log_dir = get_log_dir(cfg)
     if ctx.is_global_zero:
@@ -144,6 +161,7 @@ def main(ctx, cfg) -> None:
         # Own PRNG chain: ctx.rng() is not thread-safe and belongs to the learner.
         key = jax.random.PRNGKey(cfg.seed + 10_000 + rank)
         local_params = params
+        param_stamp: Dict[str, Any] = {}
         policy_step = policy_step0
         try:
             obs, _ = envs.reset(seed=cfg.seed + rank)
@@ -209,7 +227,15 @@ def main(ctx, cfg) -> None:
                     "advantages": advantages[..., 0],
                 }
                 data = jax.tree.map(lambda x: x.reshape(batch_n, *x.shape[2:]), data)
-                item = {"update": update, "data": data, "policy_step": policy_step, "env_time": env_time}
+                item = {
+                    "update": update,
+                    "data": data,
+                    "policy_step": policy_step,
+                    "env_time": env_time,
+                    # Policy-step age of the params this rollout acted with —
+                    # the learner logs it as Sebulba/param_staleness_steps.
+                    "staleness": staleness_steps(param_stamp, policy_step),
+                }
                 while not stop.is_set():
                     try:
                         rollout_q.put(item, timeout=1.0)
@@ -220,7 +246,7 @@ def main(ctx, cfg) -> None:
                 # Wait for the learner's parameter publication (reference :302-305).
                 while not stop.is_set():
                     try:
-                        local_params = param_q.get(timeout=1.0)
+                        local_params, param_stamp = param_q.get(timeout=1.0)
                         break
                     except queue.Empty:
                         continue
@@ -241,6 +267,10 @@ def main(ctx, cfg) -> None:
             data = item["data"]
             policy_step = item["policy_step"]
             env_time = item["env_time"]
+            maybe_digest(f"ppo:{item['update']}", data)
+            if item.get("staleness") is not None:
+                with agg_lock:
+                    aggregator.update("Sebulba/param_staleness_steps", float(item["staleness"]))
 
             clip_coef = cfg.algo.clip_coef
             ent_coef = cfg.algo.ent_coef
@@ -262,7 +292,12 @@ def main(ctx, cfg) -> None:
                 params, opt_state, train_metrics = train_fn(params, opt_state, data, key, clip_coef, ent_coef)
                 # Publish the (asynchronously dispatched) params immediately — the
                 # player's next rollout overlaps this update's device execution.
-                param_q.put(params)
+                # Freshest-wins + stamped (seq/grad_step/policy_step) so pickup
+                # staleness is measurable.
+                evict_and_put(
+                    param_q,
+                    (params, make_stamp(update, update * grad_steps_per_update, policy_step)),
+                )
                 train_metrics = jax.device_get(train_metrics)
                 train_time = time.perf_counter() - t0
             assert_finite(cfg, train_metrics, "ppo_decoupled/update")

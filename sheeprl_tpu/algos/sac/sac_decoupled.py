@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import queue
+import sys
 import threading
 import time
 from pathlib import Path
@@ -35,6 +36,9 @@ from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_transition_ring
+from sheeprl_tpu.distributed.placement import placement_from_cfg
+from sheeprl_tpu.distributed.publish import evict_and_put, make_stamp, staleness_steps
+from sheeprl_tpu.distributed.transport import maybe_digest
 from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.blocks import FusedRingDispatcher
 from sheeprl_tpu.utils.env import make_vector_env
@@ -47,6 +51,19 @@ from sheeprl_tpu.utils.utils import Ratio
 
 @register_algorithm(name="sac_decoupled", decoupled=True)
 def main(ctx, cfg) -> None:
+    # Sebulba (distributed.mode=sebulba): the player/learner threads below become
+    # placed processes — children land in sebulba.run, the launcher role places
+    # them (howto/sebulba.md).
+    spec = placement_from_cfg(cfg)
+    if spec.is_sebulba:
+        if spec.role == "launcher":
+            from sheeprl_tpu.distributed import launcher
+
+            raise SystemExit(launcher.launch(sys.argv[1:]))
+        from sheeprl_tpu.distributed import sebulba
+
+        return sebulba.run(ctx, cfg, spec, algo="sac")
+
     rank = ctx.process_index
     log_dir = get_log_dir(cfg)
     if ctx.is_global_zero:
@@ -179,6 +196,7 @@ def main(ctx, cfg) -> None:
         # the player must act on an independent copy (only the actor is needed);
         # published updates below are copies for the same reason.
         local_params = params if ring is None else {"actor": jax.tree.map(jnp.copy, params["actor"])}
+        param_stamp: Dict[str, Any] = {}
         policy_step = policy_step0
         last_ckpt = last_checkpoint
         try:
@@ -190,7 +208,7 @@ def main(ctx, cfg) -> None:
                 # Pick up the freshest published params without blocking.
                 try:
                     while True:
-                        local_params = param_q.get_nowait()
+                        local_params, param_stamp = param_q.get_nowait()
                 except queue.Empty:
                     pass
                 env_t0 = time.perf_counter()
@@ -291,6 +309,9 @@ def main(ctx, cfg) -> None:
                     # counters the sampler and the staleness stamps need.
                     "filled": len(rb),
                     "rows_added": rb.rows_added,
+                    # Policy-step age of the params this iteration acted with —
+                    # the learner logs it as Sebulba/param_staleness_steps.
+                    "staleness": staleness_steps(param_stamp, policy_step),
                 }
                 while not stop.is_set():
                     try:
@@ -306,6 +327,7 @@ def main(ctx, cfg) -> None:
 
     # ------------------------------------------------------------------ learner
     policy_step = policy_step0
+    publish_seq = 0
     try:
         for iter_num in range(start_iter, num_iters + 1):
             monitor.advance()
@@ -315,6 +337,9 @@ def main(ctx, cfg) -> None:
             policy_step = item["policy_step"]
             env_time = item["env_time"]
             grad_steps = item["grad_steps"]
+            if item.get("staleness") is not None:
+                with agg_lock:
+                    aggregator.update("Sebulba/param_staleness_steps", float(item["staleness"]))
 
             train_time = 0.0
             if grad_steps > 0 and ring is not None:
@@ -332,11 +357,16 @@ def main(ctx, cfg) -> None:
                     params, opt_state = carry["params"], carry["opt_state"]
                     # Publish a COPY of the fresh actor: the next dispatch donates
                     # ``params``, and the player must never read a donated buffer.
-                    try:
-                        param_q.get_nowait()
-                    except queue.Empty:
-                        pass
-                    param_q.put({"actor": jax.tree.map(jnp.copy, params["actor"])})
+                    # Freshest-wins: evict any unconsumed publish (a blind
+                    # put_nowait would keep STALE params on a slow player).
+                    publish_seq += 1
+                    evict_and_put(
+                        param_q,
+                        (
+                            {"actor": jax.tree.map(jnp.copy, params["actor"])},
+                            make_stamp(publish_seq, cumulative_grad_steps + grad_steps, policy_step),
+                        ),
+                    )
                     with agg_lock:
                         fused.drain(aggregator)  # one blocking device_get/iter, as before
                     train_time = time.perf_counter() - t0
@@ -353,6 +383,7 @@ def main(ctx, cfg) -> None:
                         },
                     )
             elif grad_steps > 0:
+                maybe_digest(f"sac:{item['iter_num']}", item["batches"])
                 batches = ctx.put_batch(item["batches"], batch_axis=1)
                 key = ctx.rng()
                 if recorder is not None:  # device-array references only: no host sync
@@ -368,12 +399,12 @@ def main(ctx, cfg) -> None:
                         params, opt_state, batches, key, jnp.asarray(cumulative_grad_steps)
                     )
                     # Publish the (asynchronously dispatched) params immediately;
-                    # drop stale entries — the player only wants the latest.
-                    try:
-                        param_q.get_nowait()
-                    except queue.Empty:
-                        pass
-                    param_q.put(params)
+                    # freshest-wins eviction — the player only wants the latest.
+                    publish_seq += 1
+                    evict_and_put(
+                        param_q,
+                        (params, make_stamp(publish_seq, cumulative_grad_steps + grad_steps, policy_step)),
+                    )
                     train_metrics = jax.device_get(train_metrics)
                     assert_finite(cfg, train_metrics, "sac_decoupled/update")
                     train_time = time.perf_counter() - t0

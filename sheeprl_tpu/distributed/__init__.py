@@ -1,0 +1,31 @@
+"""Sebulba placed-process topology: launcher, placement spec, transport, and the
+actor/learner process bodies for the decoupled algorithms (Podracer, arXiv
+2104.06272 §3; howto/sebulba.md).
+
+Import discipline: ``transport`` and ``placement`` are stdlib+numpy only so actor
+tooling and tests can use them without touching JAX; the heavy role bodies live
+in ``sebulba`` and import their algorithm modules lazily.
+"""
+
+from sheeprl_tpu.distributed.placement import PlacementSpec, placement_from_cfg
+from sheeprl_tpu.distributed.transport import (
+    Channel,
+    ChannelClosed,
+    FramingError,
+    Listener,
+    connect,
+    maybe_digest,
+    tree_digest,
+)
+
+__all__ = [
+    "Channel",
+    "ChannelClosed",
+    "FramingError",
+    "Listener",
+    "PlacementSpec",
+    "connect",
+    "maybe_digest",
+    "placement_from_cfg",
+    "tree_digest",
+]

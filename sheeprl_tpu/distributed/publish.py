@@ -1,0 +1,132 @@
+"""Weight publishing: how fresh learner params reach the actors.
+
+The contract (howto/sebulba.md) has three parts:
+
+1. **Freshest wins.** A publish never queues behind an older one — the stale
+   entry is evicted and the new one takes its slot (the thread path's
+   ``param_q`` does the same).  Actors may *skip* publishes, never act on
+   older-than-latest params.
+2. **Stamped.** Every publish carries ``{seq, grad_step, policy_step}`` so the
+   consumer can log ``Sebulba/param_staleness_steps`` — the policy-step gap
+   between the params it acts with and the data the learner trained them on.
+3. **No per-publish host round-trip when a device path exists.** Where the
+   actor's device is addressable from the learner process (threads on one
+   host's chips; a shared-mesh placement), the publish is one
+   ``jax.device_put`` device-to-device — asserted under
+   ``jax.transfer_guard_device_to_host("disallow")`` in the tests.  Where it is
+   not (separate CPU processes — this host), the documented fallback is ONE
+   ``jax.device_get`` per publish, wired straight into the transport channel;
+   the bytes show up in ``Sebulba/xfer_bytes``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+from sheeprl_tpu.distributed.transport import Channel, ChannelClosed
+
+#: Message kind carrying a stamped parameter block on the wire.
+PARAMS_KIND = "params"
+
+
+def make_stamp(seq: int, grad_step: int, policy_step: int) -> Dict[str, int]:
+    return {"seq": int(seq), "grad_step": int(grad_step), "policy_step": int(policy_step)}
+
+
+def staleness_steps(stamp: Optional[Dict[str, Any]], policy_step: int) -> Optional[int]:
+    """Policy-step age of ``stamp``-ed params at the consumer's ``policy_step``."""
+    if not stamp:
+        return None
+    return max(int(policy_step) - int(stamp.get("policy_step", policy_step)), 0)
+
+
+def evict_and_put(q: "queue.Queue", item: Any) -> int:
+    """Freshest-wins publish into a bounded queue: drop stale entries, never block.
+
+    Returns how many stale publishes were evicted (0 on the happy path).  This is
+    the in-process analogue of the channel publisher and the one true way to feed
+    ``param_q`` — a plain ``put_nowait`` with ``except queue.Full: pass`` silently
+    keeps the OLD params, which is exactly the staleness bug this fixes."""
+    evicted = 0
+    while True:
+        try:
+            q.put_nowait(item)
+            return evicted
+        except queue.Full:
+            try:
+                q.get_nowait()
+                evicted += 1
+            except queue.Empty:
+                pass
+
+
+class DeviceWeightPublisher:
+    """Device-path publisher: ``jax.device_put`` onto the consumer's device(s).
+
+    No host round-trip — under ``jax.transfer_guard_device_to_host("disallow")``
+    every publish still succeeds (device-to-device transfers are allowed; a
+    ``device_get`` would raise).  ``sink`` receives the stamped placement, e.g.
+    ``lambda item: evict_and_put(param_q, item)``.
+    """
+
+    def __init__(self, sink: Callable[[Tuple[Any, Dict[str, int]]], Any], device: Any = None):
+        self._sink = sink
+        self._device = device
+        self.seq = 0
+        self.bytes_published = 0
+
+    def publish(self, params: Any, *, grad_step: int, policy_step: int) -> Dict[str, int]:
+        import jax
+
+        self.seq += 1
+        stamp = make_stamp(self.seq, grad_step, policy_step)
+        placed = jax.device_put(params, self._device) if self._device is not None else params
+        self.bytes_published += sum(
+            getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(placed)
+        )
+        self._sink((placed, stamp))
+        return stamp
+
+
+class ChannelWeightPublisher:
+    """Host-fallback publisher: one ``device_get`` per publish, fanned out to every
+    live actor channel.  The single ``device_get`` is the whole documented CPU
+    cost; per-channel sends reuse its result (no per-actor re-fetch)."""
+
+    def __init__(self, channels: Callable[[], Iterable[Channel]]):
+        self._channels = channels
+        self._lock = threading.Lock()
+        self._last: Optional[Tuple[Any, Dict[str, int]]] = None
+        self.seq = 0
+        self.bytes_published = 0
+
+    def publish(self, params: Any, *, grad_step: int, policy_step: int) -> Dict[str, int]:
+        import jax
+
+        with self._lock:
+            self.seq += 1
+            stamp = make_stamp(self.seq, grad_step, policy_step)
+            host_params = jax.device_get(params)  # THE one host round-trip
+            self._last = (host_params, stamp)
+            for ch in list(self._channels()):
+                try:
+                    self.bytes_published += ch.send(PARAMS_KIND, host_params, stamp=stamp)
+                except ChannelClosed:
+                    pass  # dead actor: its respawn gets a welcome publish instead
+        return stamp
+
+    def maybe_welcome(self, ch: Channel) -> None:
+        """Seed one just-connected actor with the latest already-fetched params —
+        a respawned actor must not act on init-time params when trained ones
+        exist.  No-op before the first publish (every actor builds bit-identical
+        init params from the shared seed)."""
+        with self._lock:
+            if self._last is None:
+                return
+            host_params, stamp = self._last
+            try:
+                self.bytes_published += ch.send(PARAMS_KIND, host_params, stamp=stamp)
+            except ChannelClosed:
+                pass

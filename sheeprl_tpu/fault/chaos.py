@@ -21,6 +21,11 @@ reproducible:
   restart machinery.  The spec rides the fork into the worker process
   (``rollout/worker.py`` polls :func:`maybe_worker_fault`); only generation 0
   fires, so the restarted replacement worker runs clean.
+* ``chaos.kill_actor_at_step=N`` + ``chaos.kill_actor_index=i`` — SIGKILL Sebulba
+  ACTOR process *i* at its N-th iteration (``distributed/sebulba.py`` polls
+  :func:`maybe_actor_fault` once per iteration).  The learner must keep taking
+  gradient steps on the surviving actors' blocks while the launcher respawns the
+  victim; only actor generation 0 fires, so the respawn runs clean.
 
 Step triggers are *edge* triggers: a fault fires when the step counter crosses its
 threshold, and a run resumed past the threshold (in-process or via the supervisor)
@@ -53,6 +58,10 @@ WORKER_CRASH_EXIT_CODE = 117
 # children inherit it through fork; None means no worker fault scheduled.
 _worker_fault: Optional[Dict[str, Any]] = None
 
+# Sebulba actor-kill spec: unlike the worker fault it does not ride a fork — the
+# actor is its own CLI process whose ``install(cfg)`` parses the same overrides.
+_actor_fault: Optional[Dict[str, Any]] = None
+
 
 def _chaos_cfg(cfg: Any) -> Dict[str, Any]:
     try:
@@ -65,11 +74,17 @@ def _chaos_cfg(cfg: Any) -> Dict[str, Any]:
 def install(cfg: Any) -> None:
     """Parse the worker-fault part of the schedule into module state (call before
     any EnvPool fork; ``cli.run_algorithm`` does).  Validates the grammar loudly."""
-    global _worker_fault
+    global _worker_fault, _actor_fault
     chaos = _chaos_cfg(cfg)
     _worker_fault = None
+    _actor_fault = None
     if not chaos:
         return
+    if chaos.get("kill_actor_at_step") is not None:
+        _actor_fault = {
+            "at_step": int(chaos["kill_actor_at_step"]),
+            "actor": int(chaos.get("kill_actor_index", 0) or 0),
+        }
     sig_name = str(chaos.get("kill_signal", "SIGTERM")).upper()
     if chaos.get("kill_at_step") is not None and sig_name not in _KILL_SIGNALS:
         raise ValueError(f"chaos.kill_signal must be one of {sorted(_KILL_SIGNALS)}; got {sig_name!r}")
@@ -86,6 +101,20 @@ def install(cfg: Any) -> None:
             "worker": int(chaos.get("worker_index", 0) or 0),
             "hang_s": float(chaos.get("worker_hang_s", 3600.0)),
         }
+
+
+def maybe_actor_fault(actor_id: int, generation: int, step_count: int) -> None:
+    """Polled by the Sebulba actor loop once per iteration.  SIGKILL — no goodbye,
+    no flushed buffers — because the contract under test is the LEARNER's: its
+    gradient-step counter must keep increasing across the kill window while the
+    launcher respawns this process (generation > 0 never re-fires, so the
+    experiment terminates)."""
+    spec = _actor_fault
+    if spec is None or generation != 0 or actor_id != spec["actor"]:
+        return
+    if step_count >= spec["at_step"]:
+        _flight_recorder.record_event("chaos_actor_kill", step=step_count, actor_id=actor_id)
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def maybe_worker_fault(worker_idx: int, generation: int, step_count: int) -> None:

@@ -100,20 +100,56 @@ def shard_map_compat(fn, mesh, in_specs, out_specs):
     return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
-def maybe_init_distributed(mesh_cfg: Dict[str, Any]) -> None:
+#: Env-var spellings of ``mesh.distributed.*`` so the Sebulba launcher and
+#: hand-started processes share one init path with config-driven runs (config
+#: wins when both are set — an explicit override beats ambient environment).
+COORDINATOR_ADDRESS_ENV_VAR = "SHEEPRL_TPU_COORDINATOR_ADDRESS"
+NUM_PROCESSES_ENV_VAR = "SHEEPRL_TPU_NUM_PROCESSES"
+PROCESS_ID_ENV_VAR = "SHEEPRL_TPU_PROCESS_ID"
+
+
+def maybe_init_distributed(mesh_cfg: Dict[str, Any], timeout_s: Optional[float] = None) -> None:
     """Initialise multi-host JAX when requested (replaces Fabric ``num_nodes``).
     Takes the ``mesh`` sub-config (not the root config).  Idempotent:
     ``jax.distributed.initialize`` may only run once per process, and multirun
-    sweeps call this once per job."""
+    sweeps call this once per job.
+
+    Coordinator address / process count / process id come from the config or —
+    when the config leaves them unset — from ``SHEEPRL_TPU_COORDINATOR_ADDRESS``
+    / ``SHEEPRL_TPU_NUM_PROCESSES`` / ``SHEEPRL_TPU_PROCESS_ID``, so a launcher
+    can stamp the rendezvous on child environments without config surgery.  The
+    init itself runs under the barrier-timeout machinery: a peer that never
+    shows up raises :class:`BarrierTimeoutError` instead of hanging this process
+    forever (``SHEEPRL_TPU_BARRIER_TIMEOUT_S`` overrides, <=0 disables)."""
     global _distributed_initialized
     dist = mesh_cfg.get("distributed", {}) or {}
-    if dist.get("coordinator_address") and not _distributed_initialized:
+    coordinator = dist.get("coordinator_address") or os.environ.get(COORDINATOR_ADDRESS_ENV_VAR)
+    if not coordinator or _distributed_initialized:
+        return
+
+    def pick(key: str, env_var: str) -> Optional[int]:
+        value = dist.get(key)
+        if value is None and os.environ.get(env_var):
+            value = os.environ[env_var]
+        return None if value is None else int(value)
+
+    num_processes = pick("num_processes", NUM_PROCESSES_ENV_VAR)
+    process_id = pick("process_id", PROCESS_ID_ENV_VAR)
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("SHEEPRL_TPU_BARRIER_TIMEOUT_S", DEFAULT_BARRIER_TIMEOUT_S))
+
+    def init() -> None:
         jax.distributed.initialize(
-            coordinator_address=dist["coordinator_address"],
-            num_processes=dist.get("num_processes"),
-            process_id=dist.get("process_id"),
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
         )
-        _distributed_initialized = True
+
+    if timeout_s <= 0:
+        init()
+    else:
+        _wait_with_timeout(init, "jax_distributed_initialize", timeout_s)
+    _distributed_initialized = True
 
 
 def build_mesh(

@@ -1,0 +1,41 @@
+"""Shard the EnvPool worker budget across Sebulba actor processes.
+
+A thread-decoupled run owns the whole host, so ``env.pool.num_workers`` (or its
+cpu-count default) is a per-*host* budget.  Under Sebulba that same config is
+executed by ``num_actors`` separate processes on one host; if each actor took the
+full budget the host would oversubscribe by ``num_actors``x and the pool's
+heartbeat watchdog starts reaping workers that are merely starved.  Each actor
+therefore takes a disjoint ``1/num_actors`` slice of the budget, remainder going
+to the lowest actor ids so the total is preserved.
+
+Stdlib-only: imported by actor processes before JAX is configured.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def shard_worker_count(num_workers: Optional[int], num_actors: int, actor_id: int) -> Optional[int]:
+    """Return this actor's slice of a host-wide worker budget (>=1), or ``None``
+    to keep the pool's own default when no explicit budget was configured."""
+    if num_actors <= 1:
+        return num_workers
+    if not (0 <= actor_id < num_actors):
+        raise ValueError(f"actor_id {actor_id} out of range for num_actors {num_actors}")
+    if num_workers is None:
+        # Pool default is min(num_envs, cpu_count); shard the cpu budget instead
+        # so co-located actors do not each claim every core.
+        num_workers = max(os.cpu_count() or 1, 1)
+    base, extra = divmod(int(num_workers), num_actors)
+    return max(1, base + (1 if actor_id < extra else 0))
+
+
+def shard_pool_cfg(cfg: Any, num_actors: int, actor_id: int) -> None:
+    """Rewrite ``cfg.env.pool.num_workers`` in place to this actor's shard.
+    No-op when the pool is disabled or the run is single-actor."""
+    pool_cfg = cfg.env.get("pool") or {}
+    if not pool_cfg.get("enabled", False) or num_actors <= 1:
+        return
+    cfg.env.pool.num_workers = shard_worker_count(pool_cfg.get("num_workers"), num_actors, actor_id)

@@ -1,0 +1,12 @@
+"""``python -m sheeprl_tpu.sebulba exp=... [overrides]``: Sebulba launcher.
+
+Places one learner process plus ``distributed.num_actors`` actor processes,
+babysits them (bounded-backoff actor respawn with generation bumps), and exits
+with the learner's code; see ``sheeprl_tpu/distributed/launcher.py`` and
+``howto/sebulba.md``.
+"""
+
+from sheeprl_tpu.distributed.launcher import main
+
+if __name__ == "__main__":
+    main()

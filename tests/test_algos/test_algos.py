@@ -705,6 +705,10 @@ def test_dreamer_v3_memmap_buffer_resume(tmp_path):
     )
 
 
+@pytest.mark.slow  # ~160s — the single heaviest tier-1 test; rides the nightly
+# slow tier to protect the 870s tier-1 budget (same move as the PR-8 SAC
+# round-trip; the DV3 model-parallel math stays covered by
+# tests/test_parallel/test_dp_parity.py and the IR audit's sharded entries).
 def test_dreamer_v3_tensor_parallel_cli(tmp_path):
     """Train DreamerV3 through the CLI with mesh.data=4 x mesh.model=2 on the 8-device
     CPU mesh — tensor parallelism as a pure config knob: batch on the data axis, wide

@@ -1,0 +1,94 @@
+"""Chaos: SIGKILL one actor process mid-run; the learner must keep learning.
+
+ISSUE 13 acceptance: with 2 actors and ``chaos.kill_actor_at_step`` armed, the
+learner's gradient-step counter is STRICTLY increasing across the kill window
+(victim dead -> respawn connected) — the surviving actor keeps feeding it, no
+barrier wedges, and the launcher's respawn machinery closes the loop."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def test_actor_sigkill_learner_keeps_stepping(tmp_path):
+    summary_path = tmp_path / "summary.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        SHEEPRL_TPU_QUIET="1",
+        SHEEPRL_TPU_SEBULBA_SUMMARY=str(summary_path),
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "sheeprl_tpu.sebulba",
+            "exp=sac_decoupled",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=8",
+            "algo.learning_starts=8",
+            "algo.replay_ratio=1.0",
+            "algo.total_steps=128",
+            "algo.run_test=False",
+            "buffer.size=512",
+            "dry_run=False",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "checkpoint.every=64",
+            "checkpoint.save_last=True",
+            "metric.log_every=32",
+            "buffer.memmap=False",
+            f"log_root={tmp_path}/logs",
+            "distributed.num_actors=2",
+            "distributed.connect_timeout_s=60",
+            "distributed.respawn_backoff_s=0.2",
+            # Deterministic chaos: SIGKILL actor 0 at its 6th iteration,
+            # generation 0 only — the respawn runs clean and the experiment ends.
+            "chaos.kill_actor_at_step=6",
+            "chaos.kill_actor_index=0",
+        ],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, f"sebulba chaos run failed rc={proc.returncode}:\n{proc.stdout[-4000:]}"
+
+    summary = json.loads(summary_path.read_text())
+    events = summary["events"]  # [t, actor_id, generation, event]
+
+    # The kill window: actor 0 generation 0 vanishes, generation 1 reconnects.
+    kill_t = next(t for t, a, g, e in events if a == 0 and g == 0 and e == "closed")
+    respawn_t = next(t for t, a, g, e in events if a == 0 and g == 1 and e == "connected")
+    assert respawn_t > kill_t
+    assert any(a == 0 and g == 1 and e == "done" for _, a, g, e in events), events
+    assert any(a == 1 and e == "done" for _, a, g, e in events), events
+
+    # Learner liveness across the window: >=2 gradient-step trace points strictly
+    # inside it, counts strictly increasing (actor 1 kept it fed the whole time).
+    trace = summary["grad_step_trace"]  # [t, cumulative_grad_steps]
+    inside = [(t, g) for t, g in trace if kill_t < t < respawn_t]
+    assert len(inside) >= 2, (
+        f"learner starved during the kill window [{kill_t:.2f}, {respawn_t:.2f}]: "
+        f"{len(inside)} trace points inside (trace={trace})"
+    )
+    counts = [g for _, g in inside]
+    assert all(b > a for a, b in zip(counts, counts[1:])), counts
+
+    # And the run still completed its full budget after the respawn.
+    assert summary["cumulative_grad_steps"] >= counts[-1]
+    assert summary["blocks"] > 0

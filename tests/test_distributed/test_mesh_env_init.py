@@ -1,0 +1,86 @@
+"""``maybe_init_distributed`` env-var rendezvous + init timeout (ISSUE 13 sat. #2)."""
+
+import pytest
+
+import sheeprl_tpu.parallel.mesh as mesh_mod
+from sheeprl_tpu.parallel.mesh import (
+    BarrierTimeoutError,
+    COORDINATOR_ADDRESS_ENV_VAR,
+    NUM_PROCESSES_ENV_VAR,
+    PROCESS_ID_ENV_VAR,
+    maybe_init_distributed,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_init_flag(monkeypatch):
+    monkeypatch.setattr(mesh_mod, "_distributed_initialized", False)
+
+
+def _capture(monkeypatch):
+    calls = []
+
+    def fake_initialize(coordinator_address=None, num_processes=None, process_id=None):
+        calls.append((coordinator_address, num_processes, process_id))
+
+    monkeypatch.setattr(mesh_mod.jax.distributed, "initialize", fake_initialize)
+    return calls
+
+
+def test_noop_without_coordinator(monkeypatch):
+    calls = _capture(monkeypatch)
+    maybe_init_distributed({})
+    maybe_init_distributed({"distributed": {}})
+    assert calls == []
+    assert mesh_mod._distributed_initialized is False
+
+
+def test_cfg_coordinator_used(monkeypatch):
+    calls = _capture(monkeypatch)
+    maybe_init_distributed(
+        {"distributed": {"coordinator_address": "127.0.0.1:9911", "num_processes": 2, "process_id": 1}}
+    )
+    assert calls == [("127.0.0.1:9911", 2, 1)]
+    assert mesh_mod._distributed_initialized is True
+
+
+def test_env_var_rendezvous(monkeypatch):
+    calls = _capture(monkeypatch)
+    monkeypatch.setenv(COORDINATOR_ADDRESS_ENV_VAR, "127.0.0.1:9912")
+    monkeypatch.setenv(NUM_PROCESSES_ENV_VAR, "4")
+    monkeypatch.setenv(PROCESS_ID_ENV_VAR, "3")
+    maybe_init_distributed({"distributed": {}})
+    assert calls == [("127.0.0.1:9912", 4, 3)]
+
+
+def test_cfg_wins_over_env(monkeypatch):
+    calls = _capture(monkeypatch)
+    monkeypatch.setenv(COORDINATOR_ADDRESS_ENV_VAR, "127.0.0.1:1111")
+    monkeypatch.setenv(PROCESS_ID_ENV_VAR, "9")
+    maybe_init_distributed(
+        {"distributed": {"coordinator_address": "127.0.0.1:2222", "num_processes": 2, "process_id": 0}}
+    )
+    assert calls == [("127.0.0.1:2222", 2, 0)]
+
+
+def test_idempotent(monkeypatch):
+    calls = _capture(monkeypatch)
+    cfg = {"distributed": {"coordinator_address": "127.0.0.1:9913", "num_processes": 2, "process_id": 0}}
+    maybe_init_distributed(cfg)
+    maybe_init_distributed(cfg)
+    assert len(calls) == 1
+
+
+def test_init_timeout_raises_barrier_timeout(monkeypatch):
+    import time
+
+    def hang(**kwargs):
+        time.sleep(30.0)
+
+    monkeypatch.setattr(mesh_mod.jax.distributed, "initialize", hang)
+    with pytest.raises(BarrierTimeoutError, match="jax_distributed_initialize"):
+        maybe_init_distributed(
+            {"distributed": {"coordinator_address": "127.0.0.1:9914", "num_processes": 2, "process_id": 0}},
+            timeout_s=0.2,
+        )
+    assert mesh_mod._distributed_initialized is False

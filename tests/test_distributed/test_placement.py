@@ -1,0 +1,90 @@
+"""PlacementSpec composition: config values, env-var precedence, validation."""
+
+import pytest
+
+from sheeprl_tpu.distributed.placement import (
+    ACTOR_ID_ENV_VAR,
+    GENERATION_ENV_VAR,
+    PORT_ENV_VAR,
+    ROLE_ENV_VAR,
+    PlacementSpec,
+    placement_from_cfg,
+)
+
+
+def _cfg(**distributed):
+    base = {
+        "mode": "sebulba",
+        "role": "launcher",
+        "num_actors": 1,
+        "host": "127.0.0.1",
+        "port": 0,
+        "actor_id": 0,
+        "connect_timeout_s": 60.0,
+        "publish": "auto",
+        "queue_depth": 2,
+        "respawn": True,
+        "respawn_backoff_s": 0.5,
+        "max_actor_respawns": 3,
+    }
+    base.update(distributed)
+    return {"distributed": base}
+
+
+def test_defaults_without_distributed_section():
+    spec = placement_from_cfg({}, env={})
+    assert spec.mode == "thread" and not spec.is_sebulba
+    assert spec.role == "launcher" and spec.num_actors == 1
+
+
+def test_cfg_values_flow_through():
+    spec = placement_from_cfg(
+        _cfg(role="learner", num_actors=3, port=5001, queue_depth=7), env={}
+    )
+    assert spec.is_sebulba and spec.is_learner and not spec.is_actor
+    assert spec.num_actors == 3 and spec.port == 5001 and spec.queue_depth == 7
+
+
+def test_env_vars_take_precedence_over_cfg():
+    env = {
+        ROLE_ENV_VAR: "actor",
+        ACTOR_ID_ENV_VAR: "2",
+        PORT_ENV_VAR: "6001",
+        GENERATION_ENV_VAR: "4",
+    }
+    spec = placement_from_cfg(_cfg(role="learner", num_actors=3, port=5001), env=env)
+    assert spec.is_actor and spec.actor_id == 2
+    assert spec.port == 6001 and spec.generation == 4
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="role"):
+        PlacementSpec(role="coach")
+    with pytest.raises(ValueError, match="publish"):
+        PlacementSpec(publish="teleport")
+    with pytest.raises(ValueError, match="num_actors"):
+        PlacementSpec(num_actors=0)
+    with pytest.raises(ValueError, match="actor_id"):
+        PlacementSpec(num_actors=2, actor_id=2)
+    with pytest.raises(ValueError, match="queue_depth"):
+        PlacementSpec(queue_depth=0)
+
+
+def test_child_overrides():
+    spec = PlacementSpec(mode="sebulba", num_actors=2, host="10.0.0.5")
+    learner = spec.child_overrides("learner", 7000)
+    assert "distributed.role=learner" in learner
+    assert "distributed.port=7000" in learner
+    assert "distributed.host=10.0.0.5" in learner
+    assert "distributed.num_actors=2" in learner
+    assert not any(ov.startswith("distributed.actor_id") for ov in learner)
+    actor = spec.child_overrides("actor", 7000, actor_id=1)
+    assert "distributed.actor_id=1" in actor
+
+
+def test_composed_config_has_distributed_group():
+    from sheeprl_tpu.config.core import compose
+
+    cfg = compose(overrides=["exp=sac_decoupled", "distributed.mode=sebulba", "distributed.num_actors=2"])
+    spec = placement_from_cfg(cfg, env={})
+    assert spec.is_sebulba and spec.num_actors == 2

@@ -1,0 +1,133 @@
+"""Weight-publish contract: freshest-wins eviction, stamps/staleness, and the
+device-vs-host transfer discipline (ISSUE 13 acceptance: no per-publish
+``device_get`` on the device path, asserted via ``jax.transfer_guard``)."""
+
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.distributed.publish import (
+    DeviceWeightPublisher,
+    ChannelWeightPublisher,
+    evict_and_put,
+    make_stamp,
+    staleness_steps,
+)
+from sheeprl_tpu.distributed.transport import Listener, connect, tree_digest
+
+
+def test_evict_and_put_freshest_wins():
+    q = queue.Queue(maxsize=1)
+    assert evict_and_put(q, "v1") == 0
+    assert evict_and_put(q, "v2") == 1  # v1 evicted, not blocked behind
+    assert evict_and_put(q, "v3") == 1
+    assert q.get_nowait() == "v3"
+    assert q.empty()
+
+
+def test_evict_and_put_deeper_queue():
+    q = queue.Queue(maxsize=2)
+    assert evict_and_put(q, 1) == 0
+    assert evict_and_put(q, 2) == 0
+    assert evict_and_put(q, 3) == 1
+    assert [q.get_nowait(), q.get_nowait()] == [2, 3]
+
+
+def test_staleness_steps():
+    assert staleness_steps(None, 100) is None
+    assert staleness_steps({}, 100) is None
+    assert staleness_steps(make_stamp(1, 5, 80), 100) == 20
+    assert staleness_steps(make_stamp(1, 5, 100), 100) == 0
+    # Clock skew between producer/consumer counters never goes negative.
+    assert staleness_steps(make_stamp(1, 5, 120), 100) == 0
+
+
+def test_device_publisher_no_host_roundtrip():
+    """The device path performs NO device-to-host transfer per publish: with
+    device_to_host transfers disallowed, publishes still succeed (a device_get
+    would raise)."""
+    params = {"w": jnp.ones((8, 8)), "b": jnp.zeros((8,))}
+    q = queue.Queue(maxsize=1)
+    pub = DeviceWeightPublisher(lambda item: evict_and_put(q, item), device=jax.devices()[0])
+    with jax.transfer_guard_device_to_host("disallow"):
+        for step in range(3):
+            stamp = pub.publish(params, grad_step=step, policy_step=step * 4)
+    assert stamp == make_stamp(3, 2, 8)
+    placed, got_stamp = q.get_nowait()  # freshest-wins: only the last publish
+    assert got_stamp["seq"] == 3
+    assert isinstance(placed["w"], jax.Array)
+    assert pub.bytes_published > 0
+    # The published leaves are real device arrays the consumer can use directly.
+    np.testing.assert_array_equal(np.asarray(placed["w"]), np.ones((8, 8)))
+
+
+def test_channel_publisher_host_fallback_and_welcome():
+    """The cross-process fallback does ONE device_get per publish, fans the same
+    host copy to every channel, and replays the latest to a late joiner."""
+    params = {"w": jnp.full((4, 4), 2.0), "b": jnp.arange(4, dtype=jnp.float32)}
+    lis = Listener()
+    learner_side = []
+
+    def accept_one():
+        learner_side.append(lis.accept(5.0))
+
+    t = threading.Thread(target=accept_one)
+    t.start()
+    actor = connect("127.0.0.1", lis.port, timeout_s=5.0)
+    t.join()
+
+    pub = ChannelWeightPublisher(lambda: list(learner_side))
+    pub.publish(params, grad_step=1, policy_step=4)
+    pub.publish(params, grad_step=2, policy_step=8)
+    kinds = []
+    for _ in range(2):
+        kind, meta, payload = actor.recv(timeout=5.0)
+        kinds.append(kind)
+    assert kinds == ["params", "params"]
+    assert meta["stamp"] == make_stamp(2, 2, 8)
+    assert tree_digest(payload) == tree_digest(jax.device_get(params))
+
+    # Welcome: a channel that joins after publishes still gets the freshest stamp.
+    t2 = threading.Thread(target=accept_one)
+    t2.start()
+    late = connect("127.0.0.1", lis.port, timeout_s=5.0)
+    t2.join()
+    pub.maybe_welcome(learner_side[1])
+    kind, meta, payload = late.recv(timeout=5.0)
+    assert kind == "params" and meta["stamp"]["seq"] == 2
+    assert tree_digest(payload) == tree_digest(jax.device_get(params))
+
+    for ch in learner_side + [actor, late]:
+        ch.close()
+    lis.close()
+
+
+def test_channel_publisher_welcome_noop_before_first_publish():
+    pub = ChannelWeightPublisher(lambda: [])
+
+    class Boom:
+        def send(self, *a, **k):  # would blow up if welcome sent anything
+            raise AssertionError("welcome must be a no-op before the first publish")
+
+    pub.maybe_welcome(Boom())
+
+
+def test_channel_publisher_survives_dead_channel():
+    lis = Listener()
+    chans = []
+    t = threading.Thread(target=lambda: chans.append(lis.accept(5.0)))
+    t.start()
+    actor = connect("127.0.0.1", lis.port, timeout_s=5.0)
+    t.join()
+    actor.close()  # peer died before the publish
+    pub = ChannelWeightPublisher(lambda: list(chans))
+    params = {"w": jnp.ones((64, 64))}
+    for _ in range(50):  # outlast socket buffering; must never raise
+        pub.publish(params, grad_step=1, policy_step=1)
+    assert pub.seq == 50
+    chans[0].close()
+    lis.close()

@@ -1,0 +1,153 @@
+"""Sebulba end-to-end: real subprocess topologies on localhost (ISSUE 13).
+
+Two pins:
+
+* a 2-process SAC launcher run (learner + 1 actor) completes cleanly and its
+  summary shows blocks, gradient steps and transport bytes flowing;
+* the 1-actor PPO Sebulba placement feeds the learner BIT-IDENTICAL training
+  blocks to the in-process thread-decoupled path on the same seed (the
+  ``SHEEPRL_TPU_BATCH_DIGEST`` hook hashes every consumed block in both).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+# Every test spawns JAX subprocesses that recompile everything — slow tier.
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parents[2]
+
+SAC_OVERRIDES = [
+    "exp=sac_decoupled",
+    "env=continuous_dummy",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.hidden_size=8",
+    "algo.per_rank_batch_size=8",
+    "algo.learning_starts=4",
+    "algo.total_steps=16",
+    "buffer.size=256",
+    "dry_run=False",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "algo.run_test=False",
+    "checkpoint.every=8",
+    "checkpoint.save_last=True",
+    "metric.log_every=4",
+    "buffer.memmap=False",
+]
+
+PPO_OVERRIDES = [
+    "exp=ppo_decoupled",
+    "env=discrete_dummy",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=8",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.total_steps=64",
+    "dry_run=False",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "algo.run_test=False",
+    "checkpoint.every=32",
+    "checkpoint.save_last=True",
+    "metric.log_every=16",
+    "buffer.memmap=False",
+]
+
+
+def _child_env(**extra):
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        SHEEPRL_TPU_QUIET="1",
+    )
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+def _run(module, overrides, env, timeout):
+    proc = subprocess.run(
+        [sys.executable, "-m", module, *overrides],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, f"{module} failed rc={proc.returncode}:\n{proc.stdout[-4000:]}"
+    return proc.stdout
+
+
+def test_sebulba_sac_launcher_two_process_smoke(tmp_path):
+    """Launcher spawns learner + 1 actor as REAL processes; the run finishes,
+    writes checkpoints, and the learner summary accounts for every block."""
+    summary_path = tmp_path / "summary.json"
+    _run(
+        "sheeprl_tpu.sebulba",
+        SAC_OVERRIDES
+        + [
+            f"log_root={tmp_path}/logs",
+            "distributed.num_actors=1",
+            "distributed.connect_timeout_s=30",
+        ],
+        _child_env(SHEEPRL_TPU_SEBULBA_SUMMARY=summary_path),
+        timeout=420,
+    )
+    summary = json.loads(summary_path.read_text())
+    # 16 total steps / 2 envs = 8 actor iterations, every one shipped as a block.
+    assert summary["blocks"] == 8
+    assert summary["env_steps_total"] == 16
+    assert summary["cumulative_grad_steps"] > 0
+    assert summary["bytes_received"] > 0 and summary["bytes_published"] > 0
+    assert summary["publishes"] > 0
+    events = [(e[1], e[2], e[3]) for e in summary["events"]]
+    assert (0, 0, "connected") in events and (0, 0, "done") in events
+    ckpts = sorted((tmp_path / "logs").rglob("ckpt_*"))
+    assert ckpts, "sebulba learner wrote no checkpoint"
+
+
+def test_sebulba_ppo_one_actor_bit_identical_to_thread_path(tmp_path):
+    """The Sebulba process split must be a pure topology change: with 1 actor and
+    the same seed, the learner consumes byte-for-byte the same training blocks
+    as the thread-decoupled path (transport framing, GAE placement, and the
+    lockstep publish cadence all cancel out)."""
+    thread_digests = tmp_path / "thread.digest"
+    sebulba_digests = tmp_path / "sebulba.digest"
+
+    _run(
+        "sheeprl_tpu",
+        PPO_OVERRIDES + [f"log_root={tmp_path}/thread_logs"],
+        _child_env(SHEEPRL_TPU_BATCH_DIGEST=thread_digests),
+        timeout=420,
+    )
+    _run(
+        "sheeprl_tpu.sebulba",
+        PPO_OVERRIDES
+        + [
+            f"log_root={tmp_path}/sebulba_logs",
+            "distributed.num_actors=1",
+            "distributed.connect_timeout_s=30",
+        ],
+        _child_env(SHEEPRL_TPU_BATCH_DIGEST=sebulba_digests),
+        timeout=420,
+    )
+
+    thread_lines = thread_digests.read_text().splitlines()
+    sebulba_lines = sebulba_digests.read_text().splitlines()
+    assert thread_lines, "thread path recorded no batch digests"
+    # 64 total steps / (2 envs * 8 rollout) = 4 updates in both topologies.
+    assert len(thread_lines) == 4
+    assert sebulba_lines == thread_lines, (
+        "sebulba learner consumed different training data than the thread path:\n"
+        f"thread : {thread_lines}\nsebulba: {sebulba_lines}"
+    )

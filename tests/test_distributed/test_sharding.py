@@ -1,0 +1,34 @@
+"""EnvPool worker-budget sharding across co-located Sebulba actors."""
+
+import pytest
+
+from sheeprl_tpu.rollout.sharding import shard_worker_count
+
+
+def test_single_actor_passthrough():
+    assert shard_worker_count(8, 1, 0) == 8
+    assert shard_worker_count(None, 1, 0) is None
+
+
+def test_even_split():
+    assert [shard_worker_count(8, 2, i) for i in range(2)] == [4, 4]
+
+
+def test_remainder_to_lowest_ids():
+    shards = [shard_worker_count(8, 3, i) for i in range(3)]
+    assert shards == [3, 3, 2]
+    assert sum(shards) == 8
+
+
+def test_floor_of_one():
+    assert [shard_worker_count(2, 4, i) for i in range(4)] == [1, 1, 1, 1]
+
+
+def test_default_budget_shards_cpu_count():
+    shards = [shard_worker_count(None, 2, i) for i in range(2)]
+    assert all(isinstance(s, int) and s >= 1 for s in shards)
+
+
+def test_actor_id_out_of_range():
+    with pytest.raises(ValueError):
+        shard_worker_count(8, 2, 2)

@@ -1,0 +1,184 @@
+"""Wire layer: framing round-trips, blocking/non-blocking parity, peer death."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.distributed.transport import (
+    BATCH_DIGEST_ENV_VAR,
+    ChannelClosed,
+    FramingError,
+    Listener,
+    connect,
+    decode_tree,
+    encode_tree,
+    maybe_digest,
+    tree_digest,
+)
+
+
+def _roundtrip(tree):
+    structure, arrays = encode_tree(tree)
+    return decode_tree(structure, [memoryview(a.tobytes()) for a in arrays])
+
+
+def test_encode_decode_roundtrip_types():
+    tree = {
+        "f32": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "u8": np.full((2, 2, 2), 255, np.uint8),
+        "i64": np.int64(-7),
+        "f_scalar": np.float32(1.5),
+        "nested": {"list": [1, 2.5, None, "text", True], "tuple": (np.zeros(3), "x")},
+        "empty": {},
+        "bool_arr": np.array([True, False]),
+    }
+    back = _roundtrip(tree)
+    assert back["f32"].dtype == np.float32 and back["f32"].shape == (3, 4)
+    np.testing.assert_array_equal(back["f32"], tree["f32"])
+    np.testing.assert_array_equal(back["u8"], tree["u8"])
+    assert back["i64"] == -7 and back["f_scalar"] == 1.5
+    assert back["nested"]["list"] == [1, 2.5, None, "text", True]
+    # Tuples become lists on the wire (JSON structure), contents preserved.
+    np.testing.assert_array_equal(back["nested"]["tuple"][0], np.zeros(3))
+    np.testing.assert_array_equal(back["bool_arr"], tree["bool_arr"])
+    # Digest stability holds for array leaves (tuples land as lists and numpy
+    # scalars as python scalars on the wire — those digest differently on purpose).
+    arrays_only = {k: tree[k] for k in ("f32", "u8", "bool_arr")}
+    assert tree_digest(_roundtrip(arrays_only)) == tree_digest(arrays_only)
+
+
+def test_encode_rejects_reserved_and_nonstring_keys():
+    with pytest.raises(TypeError):
+        encode_tree({"__nd__": 1})
+    with pytest.raises(TypeError):
+        encode_tree({1: "x"})
+
+
+def test_tree_digest_detects_dtype_and_value_changes():
+    base = {"a": np.zeros(4, np.float32)}
+    assert tree_digest(base) != tree_digest({"a": np.zeros(4, np.float64)})
+    assert tree_digest(base) != tree_digest({"a": np.ones(4, np.float32)})
+    assert tree_digest(base) == tree_digest({"a": np.zeros(4, np.float32)})
+
+
+def test_maybe_digest_appends_tagged_lines(tmp_path, monkeypatch):
+    sink = tmp_path / "digests.txt"
+    monkeypatch.setenv(BATCH_DIGEST_ENV_VAR, str(sink))
+    tree = {"a": np.arange(3, dtype=np.float32)}
+    maybe_digest("sac:1", tree)
+    maybe_digest("sac:2", tree)
+    lines = sink.read_text().splitlines()
+    assert [ln.split()[0] for ln in lines] == ["sac:1", "sac:2"]
+    assert lines[0].split()[1] == tree_digest(tree)
+
+
+def test_maybe_digest_noop_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(BATCH_DIGEST_ENV_VAR, raising=False)
+    maybe_digest("tag", {"a": np.zeros(1)})  # must not raise or write anywhere
+
+
+def _server(listener, box, replies=1):
+    ch = listener.accept(5.0)
+    for _ in range(replies):
+        box.append(ch.recv(5.0))
+        ch.send("ack", None, n=len(box))
+    return ch
+
+
+def test_channel_send_recv_blocking_and_nonblocking_parity():
+    lis = Listener()
+    box = []
+    server_ch = []
+    t = threading.Thread(target=lambda: server_ch.append(_server(lis, box, replies=2)))
+    t.start()
+    ch = connect("127.0.0.1", lis.port, timeout_s=5.0)
+    payload = {"x": np.arange(8, dtype=np.int32)}
+
+    # Blocking recv.
+    ch.send("block", payload, i=0)
+    kind, meta, body = ch.recv(timeout=5.0)
+    assert kind == "ack" and meta["n"] == 1 and body is None
+
+    # Non-blocking path: poll() is False when idle, True once bytes arrive, and
+    # the subsequent recv returns the identical framing as the blocking path.
+    assert ch.poll(0) is False
+    ch.send("block", payload, i=1)
+    deadline = time.monotonic() + 5.0
+    while not ch.poll(0.05) and time.monotonic() < deadline:
+        pass
+    assert ch.poll(0) is True
+    kind2, meta2, _ = ch.recv(timeout=5.0)
+    assert kind2 == "ack" and meta2["n"] == 2
+    t.join()
+
+    k, m, p = box[0]
+    assert k == "block" and m["i"] == 0
+    assert tree_digest(p) == tree_digest(payload)
+    ch.close()
+    server_ch[0].close()
+    lis.close()
+
+
+def test_channel_close_raises_and_reconnect_works():
+    lis = Listener()
+    accepted = []
+    t = threading.Thread(target=lambda: accepted.append(lis.accept(5.0)))
+    t.start()
+    ch = connect("127.0.0.1", lis.port, timeout_s=5.0)
+    t.join()
+    # Peer dies: recv raises ChannelClosed, send raises ChannelClosed, closed=True.
+    accepted[0].close()
+    with pytest.raises(ChannelClosed):
+        ch.recv(timeout=5.0)
+    with pytest.raises(ChannelClosed):
+        for _ in range(100):  # socket buffering can absorb the first sends
+            ch.send("block", {"x": np.zeros(1024)})
+            time.sleep(0.005)
+    assert ch.closed
+    ch.close()
+
+    # The survivor reconnects to the same listener and traffic resumes.
+    t2 = threading.Thread(target=lambda: accepted.append(lis.accept(5.0)))
+    t2.start()
+    ch2 = connect("127.0.0.1", lis.port, timeout_s=5.0)
+    t2.join()
+    ch2.send("hello", None, actor_id=0)
+    kind, meta, _ = accepted[1].recv(5.0)
+    assert kind == "hello" and meta["actor_id"] == 0
+    ch2.close()
+    accepted[1].close()
+    lis.close()
+
+
+def test_bad_magic_raises_framing_error():
+    lis = Listener()
+    accepted = []
+    t = threading.Thread(target=lambda: accepted.append(lis.accept(5.0)))
+    t.start()
+    import socket
+
+    raw = socket.create_connection(("127.0.0.1", lis.port), timeout=5.0)
+    t.join()
+    raw.sendall(b"JUNKJUNKJUNKJUNK")
+    with pytest.raises(FramingError):
+        accepted[0].recv(timeout=5.0)
+    raw.close()
+    accepted[0].close()
+    lis.close()
+
+
+def test_connect_timeout():
+    lis = Listener()
+    port = lis.port
+    lis.close()  # nobody listening any more
+    with pytest.raises((ConnectionError, OSError, TimeoutError)):
+        connect("127.0.0.1", port, timeout_s=0.3, retry_interval_s=0.05)
+
+
+def test_listener_accept_timeout():
+    lis = Listener()
+    with pytest.raises(TimeoutError):
+        lis.accept(0.2)
+    lis.close()
