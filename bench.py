@@ -342,6 +342,33 @@ def bench_sebulba() -> list:
     return [json.loads(line) for line in buf.getvalue().splitlines() if line.strip()]
 
 
+def bench_serve() -> list:
+    """Serve-tier rows (``benchmarks/serve_bench.py``): continuous-batching
+    replies/s vs the naive one-request-per-dispatch baseline at 32 closed-loop
+    clients, the batched p99 latency, and warm-vs-cold replica startup through
+    the persistent compile cache.  Spawns 4 short server subprocesses.  Set
+    ``BENCH_SERVE=0`` to skip; client/request counts via ``BENCH_SERVE_CLIENTS``
+    / ``BENCH_SERVE_REQUESTS``."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    try:
+        import serve_bench
+    finally:
+        sys.path.pop(0)
+    import contextlib
+    import io
+
+    argv = [
+        "--clients", os.environ.get("BENCH_SERVE_CLIENTS", "32"),
+        "--requests", os.environ.get("BENCH_SERVE_REQUESTS", "100"),
+    ]
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        serve_bench.main(argv)
+    return [json.loads(line) for line in buf.getvalue().splitlines() if line.strip()]
+
+
 def bench_ir_audit() -> dict:
     """Wall-clock of the full ``jaxlint-ir`` audit (``sheeprl_tpu/analysis/ir``):
     AOT-lower + compile + rule-check every entry point's jitted update and both
@@ -398,6 +425,13 @@ def main() -> None:
                 print(json.dumps(row))
         except Exception as exc:
             print(json.dumps({"metric": "sebulba_env_steps_per_sec", "error": str(exc)[:200]}))
+    # Serve-tier rows (ISSUE-14): continuous batching vs naive + cold/warm start.
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        try:
+            for row in bench_serve():
+                print(json.dumps(row))
+        except Exception as exc:
+            print(json.dumps({"metric": "serve_throughput_rps", "error": str(exc)[:200]}))
     # Fault-tolerance cost rows (ISSUE-10): checkpoint save + verified restore.
     if os.environ.get("BENCH_FAULT", "1") != "0":
         try:

@@ -36,13 +36,20 @@ from typing import Dict, List, Optional, Tuple
 
 _LOWER_BETTER_HINTS = ("ms", "latency", "time", "seconds")
 # Explicit direction pins beat the unit-text heuristic: every anakin_* row
-# (benchmarks/anakin_bench.py) and sebulba_* row (benchmarks/sebulba_bench.py)
-# is a throughput — higher is better — regardless of what its unit string
-# mentions...
-_HIGHER_BETTER_PREFIXES = ("anakin_", "sebulba_")
-# ...EXCEPT the compile-cache wall-clock row, which is a duration: exact-name
-# pins win over the prefix pin.
-_LOWER_BETTER_METRICS = ("anakin_compile_seconds", "checkpoint_save_seconds", "resume_restore_seconds")
+# (benchmarks/anakin_bench.py), sebulba_* row (benchmarks/sebulba_bench.py) and
+# serve_* row (benchmarks/serve_bench.py) is a throughput — higher is better —
+# regardless of what its unit string mentions...
+_HIGHER_BETTER_PREFIXES = ("anakin_", "sebulba_", "serve_")
+# ...EXCEPT the wall-clock/latency rows, which are durations: exact-name pins
+# win over the prefix pins (serve_p99_ms is a latency SLO, serve_startup_seconds
+# is the cold/warm replica start time — both regress when they RISE).
+_LOWER_BETTER_METRICS = (
+    "anakin_compile_seconds",
+    "checkpoint_save_seconds",
+    "resume_restore_seconds",
+    "serve_p99_ms",
+    "serve_startup_seconds",
+)
 
 
 def extract_metrics(path: str) -> Dict[str, Tuple[float, str]]:

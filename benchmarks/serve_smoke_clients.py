@@ -1,0 +1,90 @@
+"""CI serve-smoke client driver (.github/workflows/cpu-tests.yaml "Serve smoke").
+
+Reads the replica's ready file, streams requests from 4 closed-loop client
+threads, asserts the SLO stamps are on every reply, then SIGTERMs the server
+PID *while requests are in flight* — each client ends on a ``draining`` reply
+or a closed channel, never a lost reply.  The workflow step then asserts the
+server exited 75 with ``accepted == replied`` in its summary.
+
+Usage::
+
+    python benchmarks/serve_smoke_clients.py <ready_file> <server_pid>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CLIENTS = 4
+REPLIES_BEFORE_SIGTERM = 100
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    ready_file, server_pid = Path(argv[0]), int(argv[1])
+
+    import numpy as np
+
+    from sheeprl_tpu.distributed.transport import ChannelClosed
+    from sheeprl_tpu.serve.client import PolicyClient, ServerDraining, wait_for_server
+
+    deadline = time.monotonic() + 300.0
+    while not ready_file.is_file():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"no ready file at {ready_file}")
+        time.sleep(0.2)
+    port = json.loads(ready_file.read_text())["port"]
+    wait_for_server("127.0.0.1", port)
+
+    obs = {"state": np.zeros(4, dtype=np.float32)}  # jax_cartpole observation
+    replies = [0] * CLIENTS
+    stamps: list = []
+    errors: list = []
+
+    def worker(idx: int) -> None:
+        try:
+            with PolicyClient("127.0.0.1", port) as client:
+                while True:
+                    _, meta = client.act(obs, "smoke_ppo", timeout=60)
+                    replies[idx] += 1
+                    stamps.append(meta)
+        except (ServerDraining, ChannelClosed, ConnectionError, TimeoutError, OSError):
+            pass  # the replica drained out from under us: a clean ending
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True) for i in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    while sum(replies) < REPLIES_BEFORE_SIGTERM:
+        if errors:
+            raise RuntimeError(f"client failed before SIGTERM: {errors[0]}")
+        time.sleep(0.01)
+
+    os.kill(server_pid, signal.SIGTERM)  # drain begins with requests in flight
+    for t in threads:
+        t.join(timeout=60)
+    if errors:
+        raise RuntimeError(f"client failed: {errors[0]}")
+
+    for meta in stamps:
+        assert meta["p99_ms"] > 0, meta  # the rolling latency SLO stamp
+        assert meta["bucket"] >= 1 and meta["infer_ms"] > 0, meta
+    print(
+        f"serve smoke: {sum(replies)} replies across {CLIENTS} clients, "
+        f"last p99={stamps[-1]['p99_ms']:.2f}ms bucket={stamps[-1]['bucket']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

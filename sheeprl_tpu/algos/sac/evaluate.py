@@ -11,6 +11,7 @@ from sheeprl_tpu.algos.sac.utils import test
 from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir
+from sheeprl_tpu.utils.policy import extract_policy_params
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
@@ -24,17 +25,7 @@ def evaluate_sac(ctx, cfg: Dict[str, Any], ckpt_path: str) -> float:
 
     actor, _, params = build_agent(ctx, act_space, obs_space, cfg)
     state = CheckpointManager.load(ckpt_path, templates={"params": jax.device_get(params)})
-    # Anakin runs (algo.anakin=True) checkpoint the whole scan carry; the policy
-    # params live inside it (engine/anakin.py).
-    params = state["carry"]["params"] if "params" not in state else state["params"]
-    if "params" not in state:
-        from sheeprl_tpu.engine.population import PopulationSpec, slice_member
-
-        if PopulationSpec.from_cfg(cfg, "sac").enabled:
-            # population checkpoints carry a leading member axis: evaluate
-            # member 0, the base-seed member (howto/population.md)
-            params = slice_member(params, 0)
-    params = ctx.replicate(params)
+    params = ctx.replicate(extract_policy_params(state, cfg, "sac"))
     reward = test(actor, params, ctx, cfg, log_dir)
     print(f"Test/cumulative_reward: {reward}")
     return reward

@@ -34,6 +34,7 @@ REGISTRY: Dict[str, str] = {
     "p2e_dv2": "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_exploration:lower_for_audit",
     "p2e_dv3": "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration:lower_for_audit",
     "anakin": "sheeprl_tpu.engine.anakin:lower_for_audit",
+    "serve": "sheeprl_tpu.serve.precompile:lower_for_audit",
 }
 
 #: the 14 CLI entry points whose jitted updates the audit must cover, plus the
@@ -60,6 +61,11 @@ EXPECTED_COVERAGE = frozenset(
         "anakin_sac",
         "anakin_ppo_pop",
         "anakin_sac_pop",
+        # The serve tier's AOT act programs (sheeprl_tpu/serve/precompile.py):
+        # the inference server dispatches ONLY precompiled ladder buckets, so the
+        # served act fns must stay under audit exactly like training dispatches.
+        "serve_ppo",
+        "serve_sac",
     }
 )
 

@@ -167,33 +167,11 @@ def run_algorithm(cfg: DotDict) -> None:
         import jax
 
         jax.config.update("jax_default_matmul_precision", str(precision))
-    compile_cache = cfg.get("compile_cache", {}) or {}
-    if compile_cache.get("enabled", False):
-        # Persistent XLA compilation cache (ROADMAP item 3's cold-start story):
-        # every compiled program is written to disk keyed by its HLO, so a
-        # second run — or a fleet cold start — deserializes instead of
-        # recompiling.  The min-compile-time/entry-size floors drop to zero so
-        # even small programs cache: a cold start wants the WHOLE program set
-        # warm, not just the multi-second flagship dispatches.
-        import jax
+    # Persistent XLA compilation cache (ROADMAP item 3's cold-start story, shared
+    # with the serve startup): see utils/compile_cache.py.
+    from sheeprl_tpu.utils.compile_cache import enable_compile_cache
 
-        cache_dir = str(
-            compile_cache.get("dir")
-            or Path.home() / ".cache" / "sheeprl_tpu" / "xla_cache"
-        )
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        try:
-            # The cache initializes lazily on the FIRST compile and then ignores
-            # config updates: if anything in this process already compiled (test
-            # harnesses, back-to-back runs), the dir set above would silently
-            # never take effect — reset so it re-initializes against it.
-            from jax.experimental.compilation_cache import compilation_cache as _cc
-
-            _cc.reset_cache()
-        except Exception:  # pragma: no cover - experimental API surface
-            pass
+    enable_compile_cache(cfg.get("compile_cache", {}) or {})
     # Fault layer (sheeprl_tpu/fault, howto/fault_tolerance.md): SIGTERM/SIGINT
     # become a sticky flag every training loop polls at its safe boundary (one
     # final checkpoint + PREEMPTED marker + exit 75), and any scheduled chaos
@@ -391,7 +369,12 @@ def _run_with_autoresume(cfg: DotDict) -> None:
 
 def _load_checkpoint_cfg(overrides: List[str], path_key: str) -> tuple:
     """Extract ``<path_key>=...`` from the overrides, load the checkpoint run's
-    config.yaml and apply the remaining overrides on top (reference ``cli.py:369-401``)."""
+    config.yaml and apply the remaining overrides on top (reference ``cli.py:369-401``).
+
+    The value may also be a registry spec ``name[:version|stage|latest]`` instead
+    of a filesystem path: it resolves through the model registry
+    (``model_manager.registry_dir`` override, or the default ``models_registry``)
+    to the registered payload, whose dir carries its own ``config.yaml``."""
     ckpt = None
     rest = []
     for ov in overrides:
@@ -402,10 +385,18 @@ def _load_checkpoint_cfg(overrides: List[str], path_key: str) -> tuple:
     if ckpt is None:
         raise ValueError(f"this entry point requires {path_key}=<path>")
     ckpt_path = Path(ckpt)
+    if not ckpt_path.exists() and not ckpt.startswith(("/", ".", "~")):
+        from sheeprl_tpu.serve.router import resolve_registry_checkpoint
+
+        name, version, ckpt_path = resolve_registry_checkpoint(ckpt, rest)
+        print(f"resolved {ckpt!r} -> {name} v{version} ({ckpt_path})")
     run_dir = ckpt_path.parent.parent if ckpt_path.is_dir() else ckpt_path.parent
     cfg_path = run_dir / "config.yaml"
     if not cfg_path.is_file():
         cfg_path = ckpt_path.parent / "config.yaml"
+    if not cfg_path.is_file() and ckpt_path.is_dir():
+        # Registry payloads are self-contained: config.yaml lives INSIDE the dir.
+        cfg_path = ckpt_path / "config.yaml"
     if not cfg_path.is_file():
         raise FileNotFoundError(f"No config.yaml found alongside checkpoint {ckpt}")
     cfg = load_config(cfg_path)

@@ -25,6 +25,14 @@ one place.
 
 ``fault.autoresume=True`` gives the same loop in-process (``cli.run``) — enough for
 SIGTERM-style chaos drills and CI; SIGKILL/OOM survival needs this supervisor.
+
+Serving mode: ``python -m sheeprl_tpu.supervise --serve <overrides>`` wraps a
+``python -m sheeprl_tpu.serve`` replica instead (:func:`supervise_serve`).
+Replicas are *stateless* — their checkpoints live in the model registry — so the
+loop is simpler: no run-dir pinning, no resume-checkpoint discovery.  Exit 0
+(clean shutdown) → done; exit 75 (SIGTERM → drained everything accepted) →
+respawn immediately, bounded by ``fault.max_preemptions``; anything else →
+retry with the same bounded backoff as training.
 """
 
 from __future__ import annotations
@@ -100,11 +108,59 @@ def _log(msg: str) -> None:
     print(f"[supervise] {msg}", flush=True)
 
 
+def supervise_serve(overrides: List[str]) -> int:
+    """The serving-mode relaunch loop: keep one stateless replica alive.
+
+    A drained preemption (rc 75) means every accepted request was answered
+    before exit — the respawn is immediate because a replica that is down is
+    pure lost capacity.  Crashes back off exactly like training retries.
+    """
+    from sheeprl_tpu.config.core import compose
+
+    cfg = compose(config_name="serve_cli", overrides=overrides)
+    f_cfg = fault_cfg(cfg)
+    max_retries = int(f_cfg.get("max_retries", 3))
+    max_preemptions = f_cfg.get("max_preemptions")  # None = respawn preemptions forever
+    base_backoff = float(f_cfg.get("backoff_s", 2.0))
+    max_backoff = float(f_cfg.get("backoff_max_s", 60.0))
+
+    retries = 0
+    preemptions = 0
+    while True:
+        env = dict(os.environ)
+        env[RESTARTS_ENV_VAR] = str(retries + preemptions)
+        _log(
+            f"serve attempt {retries + preemptions + 1} "
+            f"(retries={retries}/{max_retries}, preemptions={preemptions})"
+        )
+        proc = subprocess.run([sys.executable, "-m", "sheeprl_tpu.serve"] + overrides, env=env)
+        rc = proc.returncode
+        if rc == 0:
+            _log("replica shut down cleanly")
+            return 0
+        if rc == RESUMABLE_EXIT_CODE:
+            preemptions += 1
+            if max_preemptions is not None and preemptions > int(max_preemptions):
+                _log(f"exceeded fault.max_preemptions={max_preemptions}; giving up")
+                return rc
+            _log(f"replica drained on preemption (rc={rc}); respawning immediately")
+            continue
+        retries += 1
+        if retries > max_retries:
+            _log(f"exceeded fault.max_retries={max_retries}; giving up (rc={rc})")
+            return rc if rc else 1
+        delay = backoff_seconds(retries, base_backoff, max_backoff)
+        _log(f"replica died (rc={rc}); retry {retries}/{max_retries} in {delay:.1f}s")
+        time.sleep(delay)
+
+
 def supervise(args: Optional[List[str]] = None) -> int:
     """The relaunch loop; returns the exit code to die with."""
     from sheeprl_tpu.config.core import compose
 
     overrides = list(args if args is not None else sys.argv[1:])
+    if "--serve" in overrides:
+        return supervise_serve([ov for ov in overrides if ov != "--serve"])
     if "-m" in overrides or "--multirun" in overrides:
         raise ValueError("the supervisor wraps a single run; use one supervisor per sweep job")
     # The supervisor owns retry accounting: children never self-resume, and the

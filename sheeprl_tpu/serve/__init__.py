@@ -1,0 +1,17 @@
+"""Policy-as-a-service: AOT-precompiled inference with continuous batching.
+
+``python -m sheeprl_tpu.serve serve.policies=[name:selector,...]`` loads policies
+from the model registry, precompiles a ladder of padded batch shapes at startup
+(through the persistent XLA compilation cache, so warm restarts skip XLA
+entirely), and serves observation requests over the PR-13 framed-TCP transport
+with continuous batching: requests accumulate in a bounded queue and dispatch as
+one padded device batch the moment the current bucket fills or the
+``serve.max_batch_delay_ms`` deadline expires.  See ``howto/serving.md``.
+
+Import cost is deliberately tiny — the heavy imports (jax, agents) live in
+:mod:`sheeprl_tpu.serve.server` and load when a server actually starts.
+"""
+
+from sheeprl_tpu.serve.router import parse_spec, resolve_version
+
+__all__ = ["parse_spec", "resolve_version"]

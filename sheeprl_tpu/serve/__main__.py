@@ -1,0 +1,45 @@
+"""``python -m sheeprl_tpu.serve`` — run one policy-server replica.
+
+Overrides use the same grammar as training::
+
+    python -m sheeprl_tpu.serve \\
+        serve.policies='[cartpole_ppo:latest]' \\
+        model_manager.registry_dir=models_registry \\
+        serve.port=7557 serve.max_batch_size=32
+
+Composes the ``serve_cli`` root config (serve + model_manager + analysis +
+fault groups; the persistent compile cache defaults ON because warm-restart
+speed is the point), installs the SIGTERM→drain handlers, and exits 75
+(``RESUMABLE_EXIT_CODE``) after a preemption drain so the supervisor's
+``--serve`` mode respawns the replica.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    overrides = list(sys.argv[1:] if argv is None else argv)
+    from sheeprl_tpu.config.core import compose
+
+    cfg = compose(config_name="serve_cli", overrides=overrides)
+
+    from sheeprl_tpu.utils.compile_cache import enable_compile_cache
+
+    cache_dir = enable_compile_cache(cfg.get("compile_cache", {}) or {})
+    if cache_dir:
+        print(f"[serve] persistent compile cache: {cache_dir}", flush=True)
+
+    from sheeprl_tpu.fault.preemption import install_signal_handlers
+
+    install_signal_handlers()
+
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    return PolicyServer(cfg).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

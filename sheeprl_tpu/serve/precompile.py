@@ -1,0 +1,132 @@
+"""AOT precompilation of the serve batch ladder (+ the serve IR-audit hook).
+
+The server never calls a plainly-jitted act fn at dispatch time — that would
+leave compilation to first use and re-trace on any surprise.  Instead, startup
+lowers and compiles every ladder bucket ahead of time
+(``jit(act_fn).lower(...).compile()``) and the dispatch loop calls the returned
+``Compiled`` executables directly: a shape outside the ladder is a hard error at
+the batching layer, never a silent recompile, which is how steady-state serving
+stays recompile-free under ``analysis.strict=True`` (the PR-1 watchdog enforces
+it).
+
+Compiles go through the persistent XLA compilation cache when
+``compile_cache.enabled`` is on, so a warm replica restart deserializes the
+whole ladder from disk — the ``serve_startup_seconds`` cold/warm A/B in
+``benchmarks/serve_bench.py``.
+
+``lower_for_audit()`` exposes the two servable act programs (PPO-family and
+SAC-family, at one representative bucket) to the jaxlint-IR tier: donation,
+dtype promotion and IR006 compile-memory budgets hold for serving exactly as
+they do for training dispatches.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Sequence, Tuple
+
+import numpy as np
+
+#: dtype/shape of the PRNG key argument every act fn takes (raw threefry data;
+#: the dispatch loop derives per-dispatch keys host-side as [seed, counter]).
+KEY_SHAPE = (2,)
+KEY_DTYPE = "uint32"
+
+
+def zero_key() -> np.ndarray:
+    return np.zeros(KEY_SHAPE, np.dtype(KEY_DTYPE))
+
+
+def dispatch_key(seed: int, counter: int) -> np.ndarray:
+    """Deterministic per-dispatch PRNG key, built host-side (no device op, so the
+    steady-state loop never triggers an eager-op compile after warmup)."""
+    return np.array([seed & 0xFFFFFFFF, counter & 0xFFFFFFFF], np.dtype(KEY_DTYPE))
+
+
+def precompile_ladder(policy, ladder: Sequence[int]) -> Tuple[Dict[int, Any], float]:
+    """AOT-compile ``policy.act_fn`` at every ladder bucket.
+
+    Returns ``(bucket -> jax Compiled executable, seconds spent)``.  Each
+    executable is also run once on zeros: the first real request must never pay
+    first-call costs, and a ladder entry that compiles but cannot execute should
+    fail at startup, not mid-traffic.
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    jitted = jax.jit(policy.act_fn)
+    key = zero_key()
+    compiled: Dict[int, Any] = {}
+    for bucket in ladder:
+        obs = policy.zero_obs(int(bucket))
+        exe = jitted.lower(policy.params, obs, key).compile()
+        jax.block_until_ready(exe(policy.params, obs, key))
+        compiled[int(bucket)] = exe
+    return compiled, time.perf_counter() - t0
+
+
+# --------------------------------------------------------------------- IR audit
+def lower_for_audit():
+    """IR-audit hook (``python -m sheeprl_tpu.analysis.ir``): the serve-path act
+    programs for both servable families, lowered through the same
+    ``build_policy`` the server uses, at one representative ladder bucket."""
+    from sheeprl_tpu.analysis.ir.synth import (
+        box_act_space,
+        compose_tiny,
+        discrete_act_space,
+        tiny_ctx,
+        vector_space,
+    )
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+    from sheeprl_tpu.utils.policy import build_policy
+
+    import jax
+
+    bucket = 4
+    entries = []
+
+    ppo_cfg = compose_tiny(
+        [
+            "exp=ppo",
+            "env=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.mlp_features_dim=8",
+        ]
+    )
+    ppo_policy, _ = build_policy(
+        tiny_ctx(ppo_cfg), ppo_cfg, vector_space(), discrete_act_space(), greedy=True
+    )
+    entries.append(
+        AuditEntry(
+            name="serve/ppo_act",
+            fn=jax.jit(ppo_policy.act_fn),
+            args=(ppo_policy.params, ppo_policy.zero_obs(bucket), zero_key()),
+            covers=("serve_ppo",),
+            precision=str(ppo_cfg.mesh.precision),
+        )
+    )
+
+    sac_cfg = compose_tiny(
+        [
+            "exp=sac",
+            "env=continuous_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+        ]
+    )
+    sac_policy, _ = build_policy(
+        tiny_ctx(sac_cfg), sac_cfg, vector_space(), box_act_space(), greedy=True
+    )
+    entries.append(
+        AuditEntry(
+            name="serve/sac_act",
+            fn=jax.jit(sac_policy.act_fn),
+            args=(sac_policy.params, sac_policy.zero_obs(bucket), zero_key()),
+            covers=("serve_sac",),
+            precision=str(sac_cfg.mesh.precision),
+        )
+    )
+    return entries

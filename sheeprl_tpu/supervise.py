@@ -3,6 +3,11 @@
 Relaunches a crashed or preempted training run from the latest *valid* checkpoint
 with bounded exponential-backoff retries; see ``sheeprl_tpu/fault/supervisor.py``
 and ``howto/fault_tolerance.md``.
+
+``--serve`` flips to serving mode: the supervisor keeps one stateless
+``python -m sheeprl_tpu.serve`` replica alive instead — a SIGTERM'd replica
+drains its accepted requests, exits 75, and is respawned immediately
+(``howto/serving.md``).
 """
 
 from sheeprl_tpu.fault.supervisor import main

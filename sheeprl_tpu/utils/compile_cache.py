@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache wiring (PR 8), shared by train and serve.
+
+``compile_cache.enabled=True`` points ``jax_compilation_cache_dir`` at a disk
+cache keyed by HLO, with the min-compile-time / entry-size floors zeroed so even
+small programs cache — a cold start wants the WHOLE program set warm, not just
+the multi-second flagship dispatches.  The cache initializes lazily on the first
+compile and then ignores config updates, so :func:`enable_compile_cache` also
+resets it: back-to-back runs (or a serve replica started from a test harness
+that already compiled something) still land in the requested dir.
+
+``cli.run_algorithm`` calls this for training; the serve startup calls it before
+precompiling its batch ladder — that cache hit is the whole warm-restart story
+(``serve_startup_seconds`` in ``benchmarks/serve_bench.py``).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+
+def enable_compile_cache(compile_cache_cfg: Optional[Dict[str, Any]]) -> Optional[str]:
+    """Wire the persistent cache when ``enabled``; returns the cache dir used."""
+    compile_cache = compile_cache_cfg or {}
+    if not compile_cache.get("enabled", False):
+        return None
+    import jax
+
+    cache_dir = str(
+        compile_cache.get("dir") or Path.home() / ".cache" / "sheeprl_tpu" / "xla_cache"
+    )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        from jax.experimental.compilation_cache import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - experimental API surface
+        pass
+    return cache_dir

@@ -13,12 +13,25 @@ Two backends behind one API:
 Both expose: ``register_model(ckpt_path, name, model_keys, metadata)``,
 ``get_models()``, ``transition_model(name, version, stage)``, ``delete_model(name,
 version)`` and ``download_model(name, version, output_dir)``.
+
+Concurrency: every ``LocalModelManager`` mutation is a read-modify-write of
+``registry.json``.  Writers serialize on an ``fcntl`` advisory lock
+(``registry.lock``) held across load→mutate→save, and the save itself goes
+through a *unique* temp file + ``os.replace`` so readers never observe a torn
+index.  A population run registering K members concurrently (or the serve CLI
+racing a trainer's end-of-run registration) therefore cannot drop entries.  On
+filesystems without ``flock`` support (some NFS mounts) the lock degrades to
+best-effort: writes stay atomic individually, but concurrent writers should then
+retry registration on a lost-version check.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 import shutil
+import tempfile
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
@@ -26,6 +39,7 @@ from typing import Any, Dict, List, Optional
 from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
 
 REGISTRY_INDEX = "registry.json"
+REGISTRY_LOCK = "registry.lock"
 
 
 class LocalModelManager:
@@ -33,8 +47,28 @@ class LocalModelManager:
         self.registry_dir = Path(registry_dir)
         self.registry_dir.mkdir(parents=True, exist_ok=True)
         self._index_path = self.registry_dir / REGISTRY_INDEX
+        self._lock_path = self.registry_dir / REGISTRY_LOCK
 
     # -- index ---------------------------------------------------------------
+    @contextlib.contextmanager
+    def _locked(self):
+        """Advisory inter-process lock around a read-modify-write of the index."""
+        try:
+            import fcntl
+        except ImportError:  # pragma: no cover - non-POSIX fallback
+            yield
+            return
+        with open(self._lock_path, "a+") as lock_f:
+            try:
+                fcntl.flock(lock_f.fileno(), fcntl.LOCK_EX)
+            except OSError:  # pragma: no cover - e.g. NFS without lock support
+                yield
+                return
+            try:
+                yield
+            finally:
+                fcntl.flock(lock_f.fileno(), fcntl.LOCK_UN)
+
     def _load(self) -> Dict[str, Any]:
         if self._index_path.is_file():
             with open(self._index_path) as f:
@@ -42,10 +76,31 @@ class LocalModelManager:
         return {}
 
     def _save(self, index: Dict[str, Any]) -> None:
-        tmp = self._index_path.with_suffix(".tmp")
-        with open(tmp, "w") as f:
-            json.dump(index, f, indent=2)
-        tmp.replace(self._index_path)
+        # Unique temp name per writer: a shared .tmp would let two concurrent
+        # savers interleave write/replace and publish a torn or stale index.
+        fd, tmp_name = tempfile.mkstemp(
+            prefix=f".{REGISTRY_INDEX}.", suffix=".tmp", dir=self.registry_dir
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(index, f, indent=2)
+            os.replace(tmp_name, self._index_path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp_name)
+            raise
+
+    @staticmethod
+    def _find_run_config(src: Path) -> Optional[Path]:
+        """The training run's config.yaml for a checkpoint dir, searched the same
+        way ``cli._load_checkpoint_cfg`` does (run dir, then the checkpoints dir,
+        then inside the payload itself for re-registered downloads)."""
+        candidates = [src / "config.yaml"] if src.is_dir() else []
+        candidates += [src.parent.parent / "config.yaml", src.parent / "config.yaml"]
+        for cand in candidates:
+            if cand.is_file():
+                return cand
+        return None
 
     # -- API -----------------------------------------------------------------
     def register_model(
@@ -56,30 +111,39 @@ class LocalModelManager:
         metadata: Optional[Dict[str, Any]] = None,
     ) -> int:
         """Copy the checkpoint payload into the registry as a new version of ``name``
-        (reference ``register_model``, ``mlflow.py:75-150``)."""
-        index = self._load()
-        entry = index.setdefault(name, {"versions": []})
-        version = len(entry["versions"]) + 1
-        dest = self.registry_dir / name / f"v{version}"
-        dest.parent.mkdir(parents=True, exist_ok=True)
+        (reference ``register_model``, ``mlflow.py:75-150``).
+
+        The run's ``config.yaml`` rides along inside the version dir so the payload
+        is self-contained: evaluation and the serve CLI can rebuild the agent from
+        the registry alone, without the original run directory."""
         src = Path(ckpt_path)
-        if src.is_dir():
-            shutil.copytree(src, dest, dirs_exist_ok=True)
-        else:
-            dest.mkdir(parents=True, exist_ok=True)
-            shutil.copy2(src, dest / src.name)
-        entry["versions"].append(
-            {
-                "version": version,
-                "path": str(dest),
-                "source_checkpoint": str(src),
-                "model_keys": list(model_keys or []),
-                "stage": "None",
-                "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                "metadata": metadata or {},
-            }
-        )
-        self._save(index)
+        run_cfg = self._find_run_config(src)
+        with self._locked():
+            index = self._load()
+            entry = index.setdefault(name, {"versions": []})
+            versions = entry["versions"]
+            version = (max((v["version"] for v in versions), default=0)) + 1
+            dest = self.registry_dir / name / f"v{version}"
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            if src.is_dir():
+                shutil.copytree(src, dest, dirs_exist_ok=True)
+            else:
+                dest.mkdir(parents=True, exist_ok=True)
+                shutil.copy2(src, dest / src.name)
+            if run_cfg is not None and not (dest / "config.yaml").is_file():
+                shutil.copy2(run_cfg, dest / "config.yaml")
+            versions.append(
+                {
+                    "version": version,
+                    "path": str(dest),
+                    "source_checkpoint": str(src),
+                    "model_keys": list(model_keys or []),
+                    "stage": "None",
+                    "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    "metadata": metadata or {},
+                }
+            )
+            self._save(index)
         return version
 
     def get_models(self) -> Dict[str, Any]:
@@ -99,21 +163,25 @@ class LocalModelManager:
     def transition_model(self, name: str, version: Optional[int], stage: str) -> None:
         """Move a version to a stage (staging/production/archived), like the reference's
         MLflow stage transition (``mlflow.py:152-200``)."""
-        index = self._load()
-        self._version_entry(index, name, version)["stage"] = stage
-        self._save(index)
+        with self._locked():
+            index = self._load()
+            self._version_entry(index, name, version)["stage"] = stage
+            self._save(index)
 
     def delete_model(self, name: str, version: Optional[int] = None) -> None:
-        index = self._load()
-        if version is None:
-            for entry in index.get(name, {}).get("versions", []):
+        with self._locked():
+            index = self._load()
+            if version is None:
+                for entry in index.get(name, {}).get("versions", []):
+                    shutil.rmtree(entry["path"], ignore_errors=True)
+                index.pop(name, None)
+            else:
+                entry = self._version_entry(index, name, version)
                 shutil.rmtree(entry["path"], ignore_errors=True)
-            index.pop(name, None)
-        else:
-            entry = self._version_entry(index, name, version)
-            shutil.rmtree(entry["path"], ignore_errors=True)
-            index[name]["versions"] = [e for e in index[name]["versions"] if e["version"] != version]
-        self._save(index)
+                index[name]["versions"] = [
+                    e for e in index[name]["versions"] if e["version"] != version
+                ]
+            self._save(index)
 
     def download_model(self, name: str, version: Optional[int], output_dir: str) -> Path:
         index = self._load()

@@ -1,0 +1,204 @@
+"""Checkpoint → act-fn reconstruction, shared by evaluation and the serve path.
+
+``sheeprl_tpu.eval`` and ``python -m sheeprl_tpu.serve`` both need the same
+pipeline: rebuild the agent a checkpoint was trained with (from the run's saved
+config), load the checkpoint through :class:`CheckpointManager`, dig the policy
+params out of whatever layout the run used (host-loop ``params``, Anakin scan
+``carry``, population member axis), and wrap the actor in a pure batched
+``act_fn(params, obs_dict, key) -> actions`` that jit/AOT-compiles at any batch
+size.  This module is that pipeline, factored out of the per-algo ``evaluate``
+entries so the serve tier does not duplicate it.
+
+Servable families (stateless feed-forward policies):
+
+* ``ppo`` — ``ppo``, ``ppo_decoupled``, ``a2c``: dict observations through the
+  shared encoder; greedy mode takes the distribution mode.
+* ``sac`` — ``sac``, ``sac_decoupled``: vector observations concatenated in-graph;
+  the action is ``tanh(mean)`` rescaled to the env bounds (the reference's
+  eval-time policy).
+
+Recurrent and world-model policies (``ppo_recurrent``, the Dreamer family) carry
+per-client latent state between steps — a stateless request/reply server cannot
+serve them; :func:`policy_family` rejects them with an actionable error.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: algo name -> servable family
+PPO_FAMILY = ("ppo", "ppo_decoupled", "a2c")
+SAC_FAMILY = ("sac", "sac_decoupled")
+
+
+def policy_family(algo_name: str) -> str:
+    """The act-fn family for ``algo_name``; raises for stateful policies."""
+    if algo_name in PPO_FAMILY:
+        return "ppo"
+    if algo_name in SAC_FAMILY:
+        return "sac"
+    raise ValueError(
+        f"algorithm {algo_name!r} has no stateless act-fn builder: only "
+        f"{', '.join(PPO_FAMILY + SAC_FAMILY)} can be evaluated/served through this "
+        "path (recurrent and world-model policies carry per-step latent state)"
+    )
+
+
+def extract_policy_params(state: Dict[str, Any], cfg: Any, algo: str) -> Any:
+    """Policy params from a loaded checkpoint state, whatever the run layout.
+
+    Host-loop checkpoints store ``params`` directly; Anakin runs
+    (``algo.anakin=True``) checkpoint the whole scan carry with params inside
+    (``engine/anakin.py``); population carries add a leading member axis, of
+    which member 0 — the base-seed member — is the one evaluation and serving
+    use (``howto/population.md``).
+    """
+    params = state["carry"]["params"] if "params" not in state else state["params"]
+    if "params" not in state:
+        from sheeprl_tpu.engine.population import PopulationSpec, slice_member
+
+        if PopulationSpec.from_cfg(cfg, algo).enabled:
+            params = slice_member(params, 0)
+    return params
+
+
+@dataclass
+class LoadedPolicy:
+    """A served/evaluated policy: the pure act fn plus everything a caller needs
+    to feed it (obs template) and interpret its output (action metadata)."""
+
+    algo: str
+    family: str
+    act_fn: Callable[[Any, Dict[str, Any], Any], Any]
+    params: Any  # device pytree, exactly what act_fn's first argument expects
+    obs_template: Dict[str, Tuple[Tuple[int, ...], str]]  # key -> (shape, dtype str)
+    is_continuous: bool
+    action_dims: List[int]
+    cfg: Any = field(repr=False, default=None)
+
+    def zero_obs(self, batch: int) -> Dict[str, np.ndarray]:
+        """A zero-filled obs batch matching the template (precompile ladders)."""
+        return {
+            k: np.zeros((batch, *shape), dtype=np.dtype(dtype))
+            for k, (shape, dtype) in self.obs_template.items()
+        }
+
+
+def _ppo_act_fn(agent, greedy: bool):
+    from sheeprl_tpu.algos.ppo.utils import sample_actions
+
+    def act_fn(params, obs, key):
+        actor_out, _ = agent.apply(params, obs)
+        env_act, _, _ = sample_actions(key, actor_out, agent.is_continuous, greedy=greedy)
+        return env_act
+
+    return act_fn
+
+
+def _sac_act_fn(actor, mlp_keys: List[str], act_space):
+    import jax.numpy as jnp
+
+    low = np.asarray(act_space.low, np.float32)
+    high = np.asarray(act_space.high, np.float32)
+    rescale = bool(np.isfinite(low).all() and np.isfinite(high).all())
+
+    def act_fn(params, obs, key):
+        arrs = [
+            obs[k].reshape((obs[k].shape[0], -1)) if obs[k].ndim > 1 else obs[k][:, None]
+            for k in mlp_keys
+        ]
+        x = jnp.concatenate(arrs, axis=-1)
+        mean, _ = actor.apply(params, x)
+        act = jnp.tanh(mean)
+        if rescale:
+            act = low + (act + 1.0) * 0.5 * (high - low)
+        return act
+
+    return act_fn
+
+
+def _obs_template(obs_space, cnn_keys: List[str], mlp_keys: List[str]):
+    """Per-key (shape, dtype) the act fn expects: uint8 images pass through, vector
+    keys are float32 (mirrors the prepare_obs helpers)."""
+    template: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+    for k in cnn_keys:
+        template[k] = (tuple(obs_space[k].shape), str(np.dtype(obs_space[k].dtype)))
+    for k in mlp_keys:
+        template[k] = (tuple(obs_space[k].shape), "float32")
+    return template
+
+
+def build_policy(ctx, cfg, obs_space, act_space, greedy: bool = True) -> Tuple[LoadedPolicy, Any]:
+    """Build the agent + act fn for ``cfg.algo.name`` against explicit spaces.
+
+    Returns ``(policy, template_params)`` where ``template_params`` is the FULL
+    freshly-initialised parameter pytree (the checkpoint-load template — for SAC
+    that is the actor+critics dict even though the act fn only consumes the actor
+    slice).  ``policy.params`` holds the act-fn slice of those fresh params;
+    callers that loaded a checkpoint swap it via :func:`load_policy`.
+    """
+    algo = cfg.algo.name
+    family = policy_family(algo)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_keys = list(cfg.algo.cnn_keys.encoder) if family == "ppo" else []
+    if family == "ppo":
+        from sheeprl_tpu.algos.ppo.agent import build_agent
+
+        agent, params = build_agent(ctx, act_space, obs_space, cfg)
+        act_fn = _ppo_act_fn(agent, greedy)
+        act_params = params
+        is_continuous = bool(agent.is_continuous)
+        action_dims = [int(d) for d in agent.action_dims]
+    else:
+        from sheeprl_tpu.algos.sac.agent import build_agent
+
+        actor, _, params = build_agent(ctx, act_space, obs_space, cfg)
+        act_fn = _sac_act_fn(actor, mlp_keys, act_space)
+        act_params = params["actor"]
+        is_continuous = True
+        action_dims = [int(np.prod(act_space.shape))]
+    policy = LoadedPolicy(
+        algo=algo,
+        family=family,
+        act_fn=act_fn,
+        params=act_params,
+        obs_template=_obs_template(obs_space, cnn_keys, mlp_keys),
+        is_continuous=is_continuous,
+        action_dims=action_dims,
+        cfg=cfg,
+    )
+    return policy, params
+
+
+def load_policy(ctx, cfg, ckpt_path: str, greedy: bool = True) -> LoadedPolicy:
+    """The full pipeline: spaces from the run's env, agent rebuild, checkpoint
+    load (checksum-verified), param extraction, device placement.
+
+    ``cfg`` is the run's saved config (mutated: video capture and env count are
+    forced to the single-env serve/eval shape before the env is instantiated to
+    read its spaces).
+    """
+    import jax
+
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+    from sheeprl_tpu.utils.env import make_env
+
+    cfg.env.capture_video = False
+    cfg.env.num_envs = 1
+    env = make_env(cfg, cfg.seed, 0, None, "serve")()
+    obs_space = env.observation_space
+    act_space = env.action_space
+    env.close()
+
+    policy, template_params = build_policy(ctx, cfg, obs_space, act_space, greedy=greedy)
+    state = CheckpointManager.load(
+        ckpt_path, templates={"params": jax.device_get(template_params)}
+    )
+    params = extract_policy_params(state, cfg, policy.family)
+    if policy.family == "sac":
+        params = params["actor"]
+    policy.params = ctx.replicate(params)
+    return policy

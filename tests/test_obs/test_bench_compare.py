@@ -79,6 +79,31 @@ def test_dropped_metric_warns_loudly_and_fails_strict(tmp_path, capsys):
     assert bench_compare.main([base, new]) == 0
 
 
+def test_serve_direction_pins_exact_name_beats_prefix(tmp_path):
+    """serve_* rows are throughput (higher-better by prefix) EXCEPT the exact-name
+    latency/startup pins: serve_p99_ms and serve_startup_seconds regress UPWARD
+    even though their prefix says higher-better."""
+    assert bench_compare.lower_is_better("serve_throughput_rps", "replies/s") is False
+    # the unit string mentions "ms"/"seconds", but the prefix pin wins over hints…
+    assert bench_compare.lower_is_better("serve_whatever_new_row", "ms of something") is False
+    # …and the exact-name pins win over the prefix.
+    assert bench_compare.lower_is_better("serve_p99_ms", "ms enqueue->reply p99") is True
+    assert bench_compare.lower_is_better("serve_startup_seconds", "s spawn->ready") is True
+
+    base = _report(
+        tmp_path,
+        "BENCH_a.json",
+        {"serve_throughput_rps": (1000.0, "replies/s"), "serve_p99_ms": (5.0, "ms")},
+    )
+    new = _report(
+        tmp_path,
+        "BENCH_b.json",
+        {"serve_throughput_rps": (500.0, "replies/s"), "serve_p99_ms": (10.0, "ms")},
+    )
+    report = bench_compare.compare(base, new, threshold=0.10)
+    assert report["regressions"] == ["serve_p99_ms", "serve_throughput_rps"]
+
+
 def test_no_dropped_metrics_strict_stays_green(tmp_path):
     base = _report(tmp_path, "BENCH_a.json", {"sps": (100.0, "grad_steps/s")})
     new = _report(tmp_path, "BENCH_b.json", {"sps": (102.0, "grad_steps/s"), "extra": (1.0, "x")})

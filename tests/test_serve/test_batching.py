@@ -1,0 +1,101 @@
+"""serve/batching.py: ladder construction, bucket fit, continuous-batch collection, padding."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.batching import bucket_ladder, collect_batch, pad_obs_batch, pick_bucket
+
+
+def test_bucket_ladder_powers_of_two_always_include_max():
+    assert bucket_ladder(1) == [1]
+    assert bucket_ladder(8) == [1, 2, 4, 8]
+    assert bucket_ladder(32) == [1, 2, 4, 8, 16, 32]
+    # non-power-of-two max still tops the ladder
+    assert bucket_ladder(12) == [1, 2, 4, 8, 12]
+
+
+def test_bucket_ladder_explicit_is_validated():
+    assert bucket_ladder(16, explicit=[4, 16, 1]) == [1, 4, 16]
+    with pytest.raises(ValueError, match="must top out at serve.max_batch_size=16"):
+        bucket_ladder(16, explicit=[1, 8])
+    with pytest.raises(ValueError, match=">= 1"):
+        bucket_ladder(16, explicit=[0, 16])
+    with pytest.raises(ValueError, match=">= 1"):
+        bucket_ladder(0)
+
+
+def test_pick_bucket_smallest_fit():
+    ladder = [1, 2, 4, 8]
+    assert pick_bucket(ladder, 1) == 1
+    assert pick_bucket(ladder, 3) == 4
+    assert pick_bucket(ladder, 8) == 8
+    with pytest.raises(ValueError, match="exceeds the ladder maximum"):
+        pick_bucket(ladder, 9)
+
+
+def test_collect_batch_idle_returns_empty():
+    q = queue.Queue()
+    t0 = time.monotonic()
+    assert collect_batch(q, max_batch=4, delay_s=10.0, first_timeout_s=0.05) == []
+    # the idle poll honors first_timeout_s, NOT the (long) batch deadline
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_collect_batch_dispatches_when_full():
+    q = queue.Queue()
+    for i in range(8):
+        q.put(i)
+    # a full bucket dispatches immediately — the deadline never comes into play
+    assert collect_batch(q, max_batch=4, delay_s=60.0) == [0, 1, 2, 3]
+    assert collect_batch(q, max_batch=4, delay_s=60.0) == [4, 5, 6, 7]
+    # a leftover smaller than the bucket ships at the (short) deadline
+    q.put(8)
+    assert collect_batch(q, max_batch=4, delay_s=0.05) == [8]
+
+
+def test_collect_batch_dispatches_partial_at_deadline():
+    q = queue.Queue()
+    q.put("a")
+
+    def late_put():
+        time.sleep(0.02)
+        q.put("b")
+        time.sleep(0.3)
+        q.put("too_late")
+
+    t = threading.Thread(target=late_put, daemon=True)
+    t.start()
+    batch = collect_batch(q, max_batch=8, delay_s=0.1)
+    t.join()
+    # the first item opened the batch + deadline clock; "b" arrived inside the
+    # window, "too_late" did not — a partial batch ships at the deadline.
+    assert batch == ["a", "b"]
+    assert q.get_nowait() == "too_late"
+
+
+def test_pad_obs_batch_zero_pads_and_casts():
+    template = {"state": ((3,), "float32")}
+    obs_list = [
+        {"state": np.array([1.0, 2.0, 3.0], dtype=np.float64)},  # cast down
+        {"state": np.array([4, 5, 6], dtype=np.int32)},  # cast up
+    ]
+    out = pad_obs_batch(obs_list, template, bucket=4)
+    assert out["state"].shape == (4, 3) and out["state"].dtype == np.float32
+    np.testing.assert_array_equal(out["state"][0], [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(out["state"][1], [4.0, 5.0, 6.0])
+    np.testing.assert_array_equal(out["state"][2:], 0.0)
+
+
+def test_pad_obs_batch_validates_requests():
+    template = {"state": ((3,), "float32")}
+    good = {"state": np.zeros(3, dtype=np.float32)}
+    with pytest.raises(ValueError, match="do not fit bucket 1"):
+        pad_obs_batch([good, good], template, bucket=1)
+    with pytest.raises(KeyError, match="missing obs key 'state'"):
+        pad_obs_batch([{"wrong": np.zeros(3)}], template, bucket=2)
+    with pytest.raises(ValueError, match=r"request shape \(4,\) != policy shape \(3,\)"):
+        pad_obs_batch([{"state": np.zeros(4, dtype=np.float32)}], template, bucket=2)

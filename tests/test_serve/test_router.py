@@ -1,0 +1,85 @@
+"""serve/router.py: ``name[:selector]`` spec grammar + registry resolution."""
+
+import pytest
+
+from sheeprl_tpu.serve.router import (
+    parse_spec,
+    resolve_policy,
+    resolve_registry_checkpoint,
+    resolve_version,
+)
+from sheeprl_tpu.utils.model_manager import LocalModelManager
+
+
+def _versions(*entries):
+    return [{"version": v, "stage": s, "path": f"/reg/m/v{v}"} for v, s in entries]
+
+
+def test_parse_spec_grammar():
+    assert parse_spec("cartpole_ppo") == ("cartpole_ppo", None)
+    assert parse_spec("cartpole_ppo:latest") == ("cartpole_ppo", "latest")
+    assert parse_spec("cartpole_ppo:3") == ("cartpole_ppo", 3)
+    assert parse_spec("cartpole_ppo:production") == ("cartpole_ppo", "production")
+    assert parse_spec(" padded : 2 ") == ("padded", 2)
+    assert parse_spec("name:") == ("name", None)  # trailing colon == bare name
+    with pytest.raises(ValueError, match="empty policy name"):
+        parse_spec(":latest")
+
+
+def test_resolve_version_latest_and_exact():
+    vs = _versions((1, "None"), (3, "production"), (2, "staging"))
+    assert resolve_version(vs, None)["version"] == 3
+    assert resolve_version(vs, "latest")["version"] == 3
+    assert resolve_version(vs, 2)["version"] == 2
+    with pytest.raises(ValueError, match=r"no version 9 \(registered: \[1, 2, 3\]\)"):
+        resolve_version(vs, 9)
+    with pytest.raises(ValueError, match="no registered versions"):
+        resolve_version([], None)
+
+
+def test_resolve_version_stage_is_case_insensitive_and_picks_newest():
+    vs = _versions((1, "Production"), (2, "staging"), (3, "PRODUCTION"))
+    assert resolve_version(vs, "production")["version"] == 3
+    assert resolve_version(vs, "STAGING")["version"] == 2
+    with pytest.raises(ValueError, match="stages present"):
+        resolve_version(vs, "archived")
+
+
+def test_resolve_policy_against_registry(tmp_path):
+    ckpt = tmp_path / "ckpt_1"
+    ckpt.mkdir()
+    (ckpt / "params.msgpack").write_bytes(b"p")
+    mm = LocalModelManager(registry_dir=tmp_path / "registry")
+    mm.register_model(str(ckpt), "m")
+    mm.register_model(str(ckpt), "m")
+    mm.transition_model("m", 1, "production")
+
+    assert resolve_policy(mm, "m")[1]["version"] == 2
+    assert resolve_policy(mm, "m:latest")[1]["version"] == 2
+    assert resolve_policy(mm, "m:1")[1]["version"] == 1
+    assert resolve_policy(mm, "m:production")[1]["version"] == 1
+
+    # unknown model: the error lists what IS registered
+    with pytest.raises(ValueError, match=r"no registered model named 'ghost' \(registry has: \['m'\]\)"):
+        resolve_policy(mm, "ghost")
+    # unknown selector: the error carries the full spec for log greppability
+    with pytest.raises(ValueError, match=r"cannot resolve 'm:7'"):
+        resolve_policy(mm, "m:7")
+
+
+def test_resolve_registry_checkpoint_for_eval(tmp_path):
+    """The eval CLI's spec → payload-path resolution (same grammar, filesystem
+    routing before any config composes)."""
+    ckpt = tmp_path / "ckpt_1"
+    ckpt.mkdir()
+    (ckpt / "params.msgpack").write_bytes(b"p")
+    mm = LocalModelManager(registry_dir=tmp_path / "registry")
+    mm.register_model(str(ckpt), "m")
+
+    overrides = [f"model_manager.registry_dir={tmp_path / 'registry'}"]
+    name, version, payload = resolve_registry_checkpoint("m:1", overrides)
+    assert (name, version) == ("m", 1)
+    assert (payload / "params.msgpack").is_file()
+
+    with pytest.raises(ValueError, match="no registry exists"):
+        resolve_registry_checkpoint("m:1", [f"model_manager.registry_dir={tmp_path / 'nope'}"])

@@ -1,0 +1,207 @@
+"""PolicyServer end-to-end: registry load → AOT ladder → continuous batching →
+drain.  One tiny untrained PPO policy (serving cost is weight-agnostic) is
+checkpointed + registered once per module; each test spins an in-process server
+thread against it."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.distributed.transport import ChannelClosed
+from sheeprl_tpu.fault.preemption import clear_preemption, request_preemption
+from sheeprl_tpu.serve.client import PolicyClient, ServerDraining
+
+MODEL = "serve_test_ppo"
+
+TINY_PPO = [
+    "exp=ppo",
+    "env=jax_cartpole",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.encoder.mlp_features_dim=8",
+    "env.num_envs=1",
+    "env.capture_video=False",
+]
+
+
+@pytest.fixture(scope="module")
+def registry(tmp_path_factory):
+    """``(registry_dir, obs_template)``: two registered versions of the same tiny
+    PPO checkpoint, v1 transitioned to the ``production`` stage."""
+    import jax
+
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+    from sheeprl_tpu.config.core import compose, save_config
+    from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
+    from sheeprl_tpu.utils.env import make_env
+    from sheeprl_tpu.utils.model_manager import LocalModelManager
+    from sheeprl_tpu.utils.policy import build_policy
+
+    tmp = tmp_path_factory.mktemp("serve_registry")
+    cfg = compose(config_name="config", overrides=TINY_PPO)
+    env = make_env(cfg, 0, 0, None, "serve_test")()
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+    policy, params = build_policy(ctx, cfg, env.observation_space, env.action_space)
+    env.close()
+
+    ckpt = CheckpointManager(tmp / "run" / "checkpoints").save(0, {"params": params})
+    save_config(cfg, tmp / "run" / "config.yaml")
+    mm = LocalModelManager(registry_dir=tmp / "registry")
+    mm.register_model(str(ckpt), MODEL)
+    mm.register_model(str(ckpt), MODEL)
+    mm.transition_model(MODEL, 1, "production")
+    return tmp / "registry", policy.obs_template
+
+
+def _zero_obs(obs_template):
+    return {k: np.zeros(shape, dtype=np.dtype(dtype)) for k, (shape, dtype) in obs_template.items()}
+
+
+def _start_server(registry_dir, policies, max_batch=4, delay_ms=2.0):
+    """Compose serve_cli, build the server (precompiles the ladder), run it in a
+    thread; returns ``(server, thread, rc_box)`` once the listener is up."""
+    from sheeprl_tpu.config.core import compose
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    cfg = compose(
+        config_name="serve_cli",
+        overrides=[
+            f"serve.policies=[{','.join(policies)}]",
+            f"model_manager.registry_dir={registry_dir}",
+            "serve.host=127.0.0.1",
+            "serve.port=0",
+            f"serve.max_batch_size={max_batch}",
+            f"serve.max_batch_delay_ms={delay_ms}",
+            "serve.log_every_s=0",
+            "analysis.strict=True",
+        ],
+    )
+    server = PolicyServer(cfg)
+    rc_box = {}
+    thread = threading.Thread(target=lambda: rc_box.update(rc=server.run()), daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 30.0
+    while server.listener is None:
+        assert time.monotonic() < deadline, "server never started listening"
+        time.sleep(0.01)
+    return server, thread, rc_box
+
+
+def test_e2e_four_clients_all_replied_zero_recompiles(registry):
+    registry_dir, obs_template = registry
+    server, thread, rc_box = _start_server(registry_dir, [f"{MODEL}:1"])
+    obs = _zero_obs(obs_template)
+    clients, requests = 4, 10
+    metas = [[] for _ in range(clients)]
+    errors = []
+
+    def worker(idx):
+        try:
+            with PolicyClient("127.0.0.1", server.listener.port) as client:
+                n_heads = len(server.endpoints[f"{MODEL}:1"].policy.action_dims)
+                for _ in range(requests):
+                    action, meta = client.act(obs, MODEL)
+                    assert action.shape == (n_heads,)  # one row: [heads] action indices
+                    metas[idx].append(meta)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+    finally:
+        server.shutdown()
+        thread.join(timeout=30)
+
+    assert rc_box.get("rc") == 0  # clean shutdown, not the preemption exit code
+    summary = server.summary()
+    assert summary["accepted"] == summary["replied"] == clients * requests
+    assert summary["dropped"] == 0
+    # the AOT ladder makes post-warmup compilation impossible (analysis.strict=True
+    # would have raised RecompileError inside a dispatch otherwise)
+    assert summary["recompiles"] == 0
+    # every reply carries the SLO stamps
+    for meta in (m for per_client in metas for m in per_client):
+        assert meta["bucket"] in server.endpoints[f"{MODEL}:1"].ladder
+        assert meta["queue_ms"] >= 0 and meta["infer_ms"] > 0
+        assert 0 < meta["batch_fill"] <= 1.0
+        assert meta["p99_ms"] > 0
+
+
+def test_multi_policy_routing_and_unknown_policy(registry):
+    registry_dir, obs_template = registry
+    server, thread, _ = _start_server(registry_dir, [f"{MODEL}:1", f"{MODEL}:2"])
+    obs = _zero_obs(obs_template)
+    try:
+        with PolicyClient("127.0.0.1", server.listener.port) as client:
+            pong = client.ping()
+            assert pong["policies"] == [f"{MODEL}:1", f"{MODEL}:2"]
+            # v1 was transitioned to "production": the stage alias routes to it
+            assert f"{MODEL}:production" in pong["aliases"]
+
+            for _ in range(3):
+                client.act(obs, f"{MODEL}:2")
+            client.act(obs, MODEL)  # bare name -> first-loaded version (v1)
+            client.act(obs, f"{MODEL}:production")
+
+            with pytest.raises(RuntimeError, match="no policy routed as 'ghost'"):
+                client.act(obs, "ghost")
+    finally:
+        server.shutdown()
+        thread.join(timeout=30)
+
+    per_policy = server.summary()["policies"]
+    assert per_policy[f"{MODEL}:2"]["accepted"] == per_policy[f"{MODEL}:2"]["replied"] == 3
+    assert per_policy[f"{MODEL}:1"]["accepted"] == per_policy[f"{MODEL}:1"]["replied"] == 2
+
+
+def test_preemption_drains_and_replies_to_everything_accepted(registry):
+    registry_dir, obs_template = registry
+    server, thread, rc_box = _start_server(registry_dir, [f"{MODEL}:1"])
+    obs = _zero_obs(obs_template)
+    replies = [0, 0, 0]
+
+    def streamer(idx):
+        # closed-loop until the replica drains out from under us: a "draining"
+        # reply or a closed channel are BOTH clean endings — never a lost reply.
+        try:
+            with PolicyClient("127.0.0.1", server.listener.port) as client:
+                while True:
+                    client.act(obs, MODEL, timeout=30)
+                    replies[idx] += 1
+        except (ServerDraining, ChannelClosed, ConnectionError, TimeoutError, OSError):
+            pass
+
+    try:
+        threads = [threading.Thread(target=streamer, args=(i,), daemon=True) for i in range(3)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 20.0
+        while sum(replies) < 30 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sum(replies) >= 30, "clients never got going"
+
+        request_preemption("chaos: simulated SIGTERM")
+        thread.join(timeout=30)
+        assert not thread.is_alive()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        clear_preemption()
+        server.shutdown()
+
+    assert rc_box.get("rc") == 75  # RESUMABLE_EXIT_CODE: the supervisor respawns
+    summary = server.summary(preempted=True)
+    assert summary["preempted"] is True
+    # the drain contract: every accepted request was answered before exit
+    assert summary["accepted"] == summary["replied"]
+    assert summary["dropped"] == 0
+    assert summary["replied"] >= sum(replies)

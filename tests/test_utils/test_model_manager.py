@@ -1,6 +1,8 @@
 """Model registry tests (reference utils/mlflow.py:75-328 surface on the local backend)."""
 
 import json
+import threading
+from pathlib import Path
 
 from sheeprl_tpu.utils.model_manager import LocalModelManager
 
@@ -42,6 +44,66 @@ def test_registry_index_is_json(tmp_path):
     with open(tmp_path / "registry" / "registry.json") as f:
         idx = json.load(f)
     assert idx["m"]["versions"][0]["version"] == 1
+
+
+def test_interleaved_writers_lose_no_registrations(tmp_path):
+    """Two concurrent writers (own manager instances, like two processes sharing a
+    filesystem registry) interleaving registrations must lose none: the index is
+    locked across load→mutate→save and published via unique-temp + os.replace, so
+    the final index holds every version with distinct version numbers."""
+    ckpt = _make_ckpt(tmp_path)
+    registry = tmp_path / "registry"
+    per_writer = 10
+    errors = []
+
+    def writer(_: int) -> None:
+        try:
+            mm = LocalModelManager(registry_dir=registry)
+            for _ in range(per_writer):
+                mm.register_model(str(ckpt), "contended")
+        except Exception as e:  # noqa: BLE001 - surfaced by the assert below
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert not errors
+    versions = LocalModelManager(registry_dir=registry).get_models()["contended"]["versions"]
+    numbers = sorted(v["version"] for v in versions)
+    assert numbers == list(range(1, 2 * per_writer + 1))
+    # the atomic-save path leaves no orphaned temp files behind
+    assert not list(registry.glob(".registry.json.*"))
+    # and the published index is valid JSON, never a torn write
+    with open(registry / "registry.json") as f:
+        assert len(json.load(f)["contended"]["versions"]) == 2 * per_writer
+
+
+def test_register_copies_run_config_into_payload(tmp_path):
+    """Registration makes the payload self-contained: the run's config.yaml
+    (found at <run>/config.yaml for a <run>/checkpoints/ckpt_N source) rides
+    along inside the version dir, so eval/serve can rebuild the agent from the
+    registry alone."""
+    run = tmp_path / "run"
+    ckpt = run / "checkpoints" / "ckpt_5"
+    ckpt.mkdir(parents=True)
+    (ckpt / "params.msgpack").write_bytes(b"abc")
+    (run / "config.yaml").write_text("algo:\n  name: ppo\n")
+
+    mm = LocalModelManager(registry_dir=tmp_path / "registry")
+    v = mm.register_model(str(ckpt), "with_cfg")
+    payload = Path(mm.get_models()["with_cfg"]["versions"][v - 1]["path"])
+    assert (payload / "config.yaml").read_text().startswith("algo:")
+    # a payload that already carries its own config.yaml is not overwritten
+    src2 = tmp_path / "payload_with_cfg"
+    src2.mkdir()
+    (src2 / "params.msgpack").write_bytes(b"xyz")
+    (src2 / "config.yaml").write_text("algo:\n  name: sac\n")
+    v2 = mm.register_model(str(src2), "with_cfg")
+    payload2 = Path(mm.get_models()["with_cfg"]["versions"][v2 - 1]["path"])
+    assert "sac" in (payload2 / "config.yaml").read_text()
 
 
 def test_registration_cli_roundtrip(tmp_path, monkeypatch):
