@@ -369,6 +369,36 @@ def bench_serve() -> list:
     return [json.loads(line) for line in buf.getvalue().splitlines() if line.strip()]
 
 
+def bench_precision() -> list:
+    """Precision-tier rows (``benchmarks/precision_bench.py``): bf16 fused-PPO
+    env-steps/s vs f32, int8 serve replies/s vs f32, and the int8 parity stamp's
+    greedy action agreement.  Set ``BENCH_PRECISION=0`` to skip; scale via
+    ``BENCH_PRECISION_ENVS`` / ``BENCH_PRECISION_ITERS`` /
+    ``BENCH_PRECISION_CLIENTS``."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    try:
+        import precision_bench
+    finally:
+        sys.path.pop(0)
+    import contextlib
+    import io
+
+    argv = [
+        "--num-envs", os.environ.get("BENCH_PRECISION_ENVS", "32"),
+        "--iters", os.environ.get("BENCH_PRECISION_ITERS", "10"),
+        "--clients", os.environ.get("BENCH_PRECISION_CLIENTS", "4"),
+    ]
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        precision_bench.main(argv)
+    # the in-process servers print "[serve] ..." progress lines: keep JSON rows only
+    return [
+        json.loads(line) for line in buf.getvalue().splitlines() if line.strip().startswith("{")
+    ]
+
+
 def bench_ir_audit() -> dict:
     """Wall-clock of the full ``jaxlint-ir`` audit (``sheeprl_tpu/analysis/ir``):
     AOT-lower + compile + rule-check every entry point's jitted update and both
@@ -432,6 +462,13 @@ def main() -> None:
                 print(json.dumps(row))
         except Exception as exc:
             print(json.dumps({"metric": "serve_throughput_rps", "error": str(exc)[:200]}))
+    # Precision-tier rows (ISSUE-15): bf16 train + int8 serve A/B + parity stamp.
+    if os.environ.get("BENCH_PRECISION", "1") != "0":
+        try:
+            for row in bench_precision():
+                print(json.dumps(row))
+        except Exception as exc:
+            print(json.dumps({"metric": "anakin_bf16_steps_per_sec", "error": str(exc)[:200]}))
     # Fault-tolerance cost rows (ISSUE-10): checkpoint save + verified restore.
     if os.environ.get("BENCH_FAULT", "1") != "0":
         try:

@@ -36,10 +36,12 @@ from typing import Dict, List, Optional, Tuple
 
 _LOWER_BETTER_HINTS = ("ms", "latency", "time", "seconds")
 # Explicit direction pins beat the unit-text heuristic: every anakin_* row
-# (benchmarks/anakin_bench.py), sebulba_* row (benchmarks/sebulba_bench.py) and
-# serve_* row (benchmarks/serve_bench.py) is a throughput — higher is better —
-# regardless of what its unit string mentions...
-_HIGHER_BETTER_PREFIXES = ("anakin_", "sebulba_", "serve_")
+# (benchmarks/anakin_bench.py), sebulba_* row (benchmarks/sebulba_bench.py),
+# serve_* row (benchmarks/serve_bench.py) and precision_* row
+# (benchmarks/precision_bench.py — parity/agreement fractions AND the bf16/int8
+# throughputs ride the anakin_/serve_ prefixes) is higher-better regardless of
+# what its unit string mentions...
+_HIGHER_BETTER_PREFIXES = ("anakin_", "sebulba_", "serve_", "precision_")
 # ...EXCEPT the wall-clock/latency rows, which are durations: exact-name pins
 # win over the prefix pins (serve_p99_ms is a latency SLO, serve_startup_seconds
 # is the cold/warm replica start time — both regress when they RISE).

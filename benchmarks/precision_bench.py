@@ -1,0 +1,259 @@
+"""Precision-tier benchmark: bf16 mixed-precision training and int8 serving A/B
+against their f32 baselines (howto/precision.md).
+
+Three BENCH-style JSON rows on stdout (``benchmarks/bench_compare.py`` pins the
+directions: ``precision_*`` is higher-better by prefix, and the throughput rows
+ride the existing ``anakin_``/``serve_`` higher-better prefixes):
+
+* ``anakin_bf16_steps_per_sec`` — env-steps/s of the fused PPO Anakin iteration
+  under ``algo.precision=bf16`` (params/optimizer f32, compute bf16), with the
+  f32 run of the SAME program and the speedup ratio riding as extras.  The mesh
+  is pinned to fp32 so the algo knob is the ONLY difference between the tiers.
+* ``serve_int8_replies_per_sec`` — replies/s of the continuously-batched policy
+  server under ``serve.precision=int8`` (weight-only per-channel quantization,
+  dequant fused into the act dispatch), f32 replies/s and the ratio as extras.
+  Same transport, same AOT ladder, same closed-loop clients.
+* ``precision_parity_action_agreement`` — the int8 server's parity stamp vs its
+  f32 reference reload (greedy action agreement on seeded random observations):
+  the acceptance floor is 0.99, and a DROP in this row is the regression.
+
+Serving is benchmarked on a freshly-initialised tiny PPO checkpoint (serving
+cost is weight-agnostic); training throughput on the pure-JAX CartPole.
+
+Usage::
+
+    python benchmarks/precision_bench.py
+    python benchmarks/precision_bench.py --num-envs 64 --iters 20 --clients 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("SHEEPRL_TPU_QUIET", "1")
+
+import gymnasium as gym  # noqa: E402
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from sheeprl_tpu.config.core import compose  # noqa: E402
+from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh  # noqa: E402
+
+MODEL_NAME = "precision_bench_ppo"
+
+TINY_PPO = [
+    "exp=ppo",
+    "env=jax_cartpole",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.dense_units=64",
+    "algo.mlp_layers=2",
+    "algo.encoder.mlp_features_dim=64",
+    "env.num_envs=1",
+    "env.capture_video=False",
+]
+
+
+def bench_anakin_precision(precision: str, num_envs: int, rollout_steps: int, iters: int, seed: int = 0) -> float:
+    """Env-steps/s of the fused PPO Anakin iteration at ``algo.precision=<tier>``
+    (mesh pinned fp32 so the algo knob is the only difference)."""
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+    from sheeprl_tpu.engine.anakin import init_episode_stats, make_ppo_anakin_iteration, reset_envs
+    from sheeprl_tpu.envs.jax import make_jax_env
+
+    cfg = compose(
+        overrides=[
+            "exp=ppo",
+            "env=jax_cartpole",
+            "algo.anakin=True",
+            "algo.mlp_keys.encoder=[state]",
+            f"env.num_envs={num_envs}",
+            f"algo.rollout_steps={rollout_steps}",
+            f"algo.per_rank_batch_size={max(rollout_steps * num_envs // 4, 1)}",
+            "algo.update_epochs=4",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "mesh.precision=fp32",
+            f"algo.precision={precision}",
+        ]
+    )
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=seed)
+    env = make_jax_env("cartpole")
+    env_params = env.default_params()
+    obs_space = gym.spaces.Dict({"state": env.observation_space(env_params)})
+    agent, params = build_agent(ctx, env.action_space(env_params), obs_space, cfg)
+    fns = PPOTrainFns(ctx, agent, cfg, ["state"], max(iters, 1))
+    opt_state = ctx.replicate(fns.opt.init(params))
+    iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, "state")
+    dispatch = jax.jit(iteration, donate_argnums=(0,))
+
+    env_state, obs0 = reset_envs(env, env_params, num_envs, jax.random.PRNGKey(seed))
+    carry = {
+        "params": params,
+        "opt_state": opt_state,
+        "env_state": env_state,
+        "obs": obs0,
+        "key": jax.random.PRNGKey(seed + 1),
+        "episode_stats": init_episode_stats(num_envs),
+    }
+    carry, metrics = dispatch(carry, 0.2, 0.0)  # warmup/compile
+    jax.device_get(metrics)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry, metrics = dispatch(carry, 0.2, 0.0)
+    jax.device_get(metrics)
+    elapsed = time.perf_counter() - t0
+    return iters * rollout_steps * num_envs / elapsed
+
+
+def build_artifact(tmp: Path):
+    """Checkpoint + register an untrained tiny PPO policy; returns
+    ``(registry_dir, obs_template)``."""
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+    from sheeprl_tpu.config.core import save_config
+    from sheeprl_tpu.utils.env import make_env
+    from sheeprl_tpu.utils.model_manager import LocalModelManager
+    from sheeprl_tpu.utils.policy import build_policy
+
+    cfg = compose(config_name="config", overrides=TINY_PPO)
+    env = make_env(cfg, 0, 0, None, "precision_bench")()
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+    policy, params = build_policy(ctx, cfg, env.observation_space, env.action_space)
+    env.close()
+
+    ckpt_path = CheckpointManager(tmp / "run" / "checkpoints").save(0, {"params": params})
+    save_config(cfg, tmp / "run" / "config.yaml")
+    registry = tmp / "registry"
+    LocalModelManager(registry_dir=str(registry)).register_model(str(ckpt_path), MODEL_NAME)
+    return registry, policy.obs_template
+
+
+def bench_serve_precision(registry: Path, obs_template, precision: str, clients: int, requests: int):
+    """In-process server at ``serve.precision=<tier>`` driven by closed-loop
+    clients; returns ``(replies_per_sec, parity_stamp_or_None)``."""
+    from sheeprl_tpu.serve.client import PolicyClient
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    cfg = compose(
+        config_name="serve_cli",
+        overrides=[
+            f"serve.policies=[{MODEL_NAME}:1]",
+            f"model_manager.registry_dir={registry}",
+            "serve.host=127.0.0.1",
+            "serve.port=0",
+            f"serve.max_batch_size={max(clients, 1)}",
+            "serve.log_every_s=0",
+            f"serve.precision={precision}",
+        ],
+    )
+    server = PolicyServer(cfg)
+    thread = threading.Thread(target=server.run, daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 120.0
+    while server.listener is None:
+        if time.monotonic() > deadline:
+            raise TimeoutError("server never started listening")
+        time.sleep(0.01)
+
+    obs = {k: np.zeros(shape, dtype=np.dtype(dtype)) for k, (shape, dtype) in obs_template.items()}
+    replies = [0] * clients
+    errors: List[Exception] = []
+    barrier = threading.Barrier(clients + 1)
+
+    def worker(idx: int) -> None:
+        try:
+            client = PolicyClient("127.0.0.1", server.listener.port)
+            barrier.wait()
+            for _ in range(requests):
+                client.act(obs, MODEL_NAME, timeout=60)
+                replies[idx] += 1
+            client.close()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True) for i in range(clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    finally:
+        server.shutdown()
+        thread.join(timeout=60)
+    if errors:
+        raise RuntimeError(f"{len(errors)} client(s) failed: {errors[0]}")
+    stamp = server.parity.get(f"{MODEL_NAME}:1")
+    return sum(replies) / wall if wall > 0 else 0.0, stamp
+
+
+def main(argv: Optional[List[str]] = None) -> Dict[str, float]:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--num-envs", type=int, default=32)
+    parser.add_argument("--rollout", type=int, default=64)
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--requests", type=int, default=50, help="round-trips per client")
+    args = parser.parse_args(argv)
+
+    f32_sps = bench_anakin_precision("f32", args.num_envs, args.rollout, args.iters)
+    bf16_sps = bench_anakin_precision("bf16", args.num_envs, args.rollout, args.iters)
+
+    tmp = Path(tempfile.mkdtemp(prefix="precision_bench_"))
+    registry, obs_template = build_artifact(tmp)
+    f32_rps, _ = bench_serve_precision(registry, obs_template, "f32", args.clients, args.requests)
+    int8_rps, stamp = bench_serve_precision(registry, obs_template, "int8", args.clients, args.requests)
+
+    rows = [
+        {
+            "metric": "anakin_bf16_steps_per_sec",
+            "value": round(bf16_sps, 1),
+            "unit": (
+                f"env_steps/s, fused PPO Anakin iteration at algo.precision=bf16 "
+                f"({args.num_envs} envs x {args.rollout} rollout, mesh pinned fp32, 1 chip)"
+            ),
+            "f32_steps_per_sec": round(f32_sps, 1),
+            "bf16_speedup_vs_f32": round(bf16_sps / f32_sps, 2) if f32_sps > 0 else None,
+        },
+        {
+            "metric": "serve_int8_replies_per_sec",
+            "value": round(int8_rps, 2),
+            "unit": (
+                f"replies/s, continuous batching at serve.precision=int8 "
+                f"({args.clients} closed-loop clients x {args.requests} requests)"
+            ),
+            "f32_replies_per_sec": round(f32_rps, 2),
+            "int8_speedup_vs_f32": round(int8_rps / f32_rps, 2) if f32_rps > 0 else None,
+        },
+        {
+            "metric": "precision_parity_action_agreement",
+            "value": round(float(stamp["action_agreement"]), 4) if stamp else None,
+            "unit": "fraction of greedy actions agreeing, int8 server vs f32 reference (floor 0.99)",
+            "n_obs": stamp["n_obs"] if stamp else None,
+        },
+    ]
+    for row in rows:
+        print(json.dumps(row))
+    return {row["metric"]: row["value"] for row in rows}
+
+
+if __name__ == "__main__":
+    main()

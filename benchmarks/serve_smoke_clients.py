@@ -6,9 +6,13 @@ PID *while requests are in flight* — each client ends on a ``draining`` reply
 or a closed channel, never a lost reply.  The workflow step then asserts the
 server exited 75 with ``accepted == replied`` in its summary.
 
+The optional third argument pins the replica's precision tier: the ready file
+must carry that ``precision`` and, for a non-f32 tier, a parity stamp vs the
+f32 reference with >= 0.99 greedy action agreement (howto/precision.md).
+
 Usage::
 
-    python benchmarks/serve_smoke_clients.py <ready_file> <server_pid>
+    python benchmarks/serve_smoke_clients.py <ready_file> <server_pid> [precision]
 """
 
 from __future__ import annotations
@@ -31,6 +35,7 @@ REPLIES_BEFORE_SIGTERM = 100
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     ready_file, server_pid = Path(argv[0]), int(argv[1])
+    expected_precision = argv[2] if len(argv) > 2 else None
 
     import numpy as np
 
@@ -42,7 +47,15 @@ def main(argv=None) -> int:
         if time.monotonic() > deadline:
             raise TimeoutError(f"no ready file at {ready_file}")
         time.sleep(0.2)
-    port = json.loads(ready_file.read_text())["port"]
+    ready = json.loads(ready_file.read_text())
+    port = ready["port"]
+    if expected_precision is not None:
+        assert ready["precision"] == expected_precision, ready
+        if expected_precision != "f32":
+            for name, stamp in ready["parity"].items():
+                assert stamp["reference"] == "f32", (name, stamp)
+                assert stamp["action_agreement"] >= 0.99, (name, stamp)
+            assert ready["parity"], "non-f32 replica published no parity stamp"
     wait_for_server("127.0.0.1", port)
 
     obs = {"state": np.zeros(4, dtype=np.float32)}  # jax_cartpole observation

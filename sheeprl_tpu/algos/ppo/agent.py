@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.models.blocks import MLP, MultiEncoder
+from sheeprl_tpu.precision import train_policy
 
 
 def parse_action_space(action_space: gymnasium.spaces.Space) -> Tuple[bool, Tuple[int, ...]]:
@@ -108,7 +109,10 @@ def build_agent(
         mlp_layers=cfg.algo.mlp_layers,
         dense_act=cfg.algo.dense_act,
         layer_norm=cfg.algo.layer_norm,
-        dtype=ctx.compute_dtype,
+        # algo.precision resolves the compute dtype ("mesh" inherits
+        # ctx.compute_dtype); flax param_dtype stays f32 so params/optimizer
+        # state are full precision under every mixed policy (howto/precision.md).
+        dtype=train_policy(cfg, ctx).compute_dtype,
     )
     dummy_obs = {}
     for k in list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder):

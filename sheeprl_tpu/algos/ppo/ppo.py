@@ -48,6 +48,7 @@ from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled
+from sheeprl_tpu.precision import train_policy
 from sheeprl_tpu.rollout import PipelinedPlayer, rollout_metrics
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -162,20 +163,27 @@ class PPOTrainFns:
         opt = self.opt
         strict = strict_enabled(cfg)
         health = health_enabled(cfg)  # trace-time constant (obs/health.py)
+        # Precision boundary (howto/precision.md): float observation batches are
+        # cast to the policy's compute dtype BEFORE the first matmul, so under
+        # bf16 the whole forward runs low-precision; heads cast back to f32.
+        precision = train_policy(cfg, ctx)
+
+        def cast_obs(obs):
+            return precision.cast_to_compute(obs)
 
         @jax.jit
         def act_fn(p, obs, key):
-            actor_out, value = agent.apply(p, obs)
+            actor_out, value = agent.apply(p, cast_obs(obs))
             env_act, stored_act, logprob = sample_actions(key, actor_out, is_continuous)
             return env_act, stored_act, logprob, value[..., 0]
 
         @jax.jit
         def values_fn(p, obs):
-            _, value = agent.apply(p, obs)
+            _, value = agent.apply(p, cast_obs(obs))
             return value[..., 0]
 
         def loss_fn(p, mb, clip_coef, ent_coef):
-            actor_out, new_values = agent.apply(p, {k: mb[k] for k in obs_keys})
+            actor_out, new_values = agent.apply(p, cast_obs({k: mb[k] for k in obs_keys}))
             new_logprob, entropy = log_prob_and_entropy(actor_out, mb["actions"], is_continuous)
             adv = mb["advantages"]
             if cfg.algo.normalize_advantages:

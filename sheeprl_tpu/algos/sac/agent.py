@@ -23,6 +23,7 @@ import numpy as np
 
 from sheeprl_tpu.distributions import TanhNormal
 from sheeprl_tpu.models.blocks import MLP
+from sheeprl_tpu.precision import train_policy
 
 LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
 
@@ -83,9 +84,13 @@ def build_agent(
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
     obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
 
-    actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size, dtype=ctx.compute_dtype)
+    # algo.precision resolves the compute dtype ("mesh" inherits ctx.compute_dtype);
+    # flax param_dtype stays f32 so params/optimizer state are full precision
+    # under every mixed policy (howto/precision.md).
+    compute_dtype = train_policy(cfg, ctx).compute_dtype
+    actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size, dtype=compute_dtype)
     critic = SACCriticEnsemble(
-        n_critics=cfg.algo.critic.n, hidden_size=cfg.algo.critic.hidden_size, dtype=ctx.compute_dtype
+        n_critics=cfg.algo.critic.n, hidden_size=cfg.algo.critic.hidden_size, dtype=compute_dtype
     )
     dummy_obs = jnp.zeros((1, obs_dim))
     dummy_act = jnp.zeros((1, act_dim))

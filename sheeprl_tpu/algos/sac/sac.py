@@ -32,6 +32,7 @@ from sheeprl_tpu.data.device_buffer import make_transition_ring
 from sheeprl_tpu.data.prefetch import maybe_prefetcher
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
+from sheeprl_tpu.precision import train_policy
 from sheeprl_tpu.rollout import PipelinedPlayer, rollout_metrics
 from sheeprl_tpu.utils.blocks import FusedRingDispatcher, WindowedFutures
 from sheeprl_tpu.utils.env import make_vector_env
@@ -64,6 +65,10 @@ def make_sac_step_fn(actor, critic, cfg, act_space, inject_lr=()):
     gamma = cfg.algo.gamma
 
     health = health_enabled(cfg)  # trace-time constant (obs/health.py)
+    # Precision boundary (howto/precision.md): sampled float obs are cast to the
+    # policy's compute dtype before the first matmul; losses/targets stay f32
+    # (the agents' heads cast their outputs back up).
+    precision = train_policy(cfg)
     actor_opt = make_optimizer(
         cfg.algo.actor.optimizer, cfg.algo.get("max_grad_norm", 0.0), inject_lr="actor" in inject_lr
     )
@@ -74,13 +79,8 @@ def make_sac_step_fn(actor, critic, cfg, act_space, inject_lr=()):
 
     def _losses(p, batch, key):
         key_next, key_new = jax.random.split(key)
-        obs, action, reward, done, next_obs = (
-            batch["obs"],
-            batch["actions"],
-            batch["rewards"],
-            batch["dones"],
-            batch["next_obs"],
-        )
+        obs, next_obs = precision.cast_to_compute((batch["obs"], batch["next_obs"]))
+        action, reward, done = batch["actions"], batch["rewards"], batch["dones"]
         alpha = jnp.exp(p["log_alpha"])
 
         # --- critic target (reference sac.py:39-47)

@@ -66,6 +66,13 @@ EXPECTED_COVERAGE = frozenset(
         # served act fns must stay under audit exactly like training dispatches.
         "serve_ppo",
         "serve_sac",
+        # Precision tier (howto/precision.md): the algo.precision=bf16 Anakin
+        # dispatches (IR002 proves bf16 on the dots with mesh pinned to fp32)
+        # and the serve.precision=int8 act programs (dequant-in-jit kernels).
+        "anakin_ppo_bf16",
+        "anakin_sac_bf16",
+        "serve_ppo_int8",
+        "serve_sac_int8",
     }
 )
 

@@ -166,6 +166,18 @@ def run_algorithm(cfg: DotDict) -> None:
         # reference: torch.set_float32_matmul_precision(cfg.float32_matmul_precision)
         import jax
 
+        algo_precision = str(cfg.algo.get("precision", "mesh")).lower()
+        if any(t in algo_precision for t in ("bf16", "fp16", "16-mixed", "16-true")):
+            # jax_default_matmul_precision only governs f32 dots; with an
+            # explicit 16-bit algo.precision the knob is dead weight and
+            # silently proceeding hides that (howto/precision.md).
+            warnings.warn(
+                f"float32_matmul_precision={precision!r} has no effect: "
+                f"algo.precision={algo_precision!r} runs the matmuls in 16-bit "
+                "compute, so the f32 dot precision knob never applies — set "
+                "algo.precision=f32 if you want full-precision matmuls",
+                stacklevel=2,
+            )
         jax.config.update("jax_default_matmul_precision", str(precision))
     # Persistent XLA compilation cache (ROADMAP item 3's cold-start story, shared
     # with the serve startup): see utils/compile_cache.py.

@@ -286,6 +286,24 @@ class DeviceReplayMirror:
 
 STAMP_KEY = "_stamp"
 
+#: ring keys eligible for reduced-precision storage (buffer.store_dtype): the
+#: wide observation planes.  Actions/rewards/dones are a rounding error of the
+#: ring's HBM footprint and stay at their declared dtype.
+STORE_DTYPE_KEYS = ("obs", "next_obs")
+
+
+def resolve_store_dtype(spec) -> Optional[Any]:
+    """Map ``buffer.store_dtype`` (``null`` | ``f32`` | ``bf16``) to a dtype, or
+    ``None`` for full-precision storage."""
+    if spec is None:
+        return None
+    key = str(spec).lower()
+    if key in ("", "none", "null", "f32", "fp32", "float32"):
+        return None
+    if key in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    raise ValueError(f"Unknown buffer.store_dtype {spec!r}; expected null, f32 or bf16")
+
 
 class DeviceTransitionRing(DeviceReplayMirror):
     """Device-resident uniform-replay ring for FLAT transition batches — the SAC
@@ -308,13 +326,33 @@ class DeviceTransitionRing(DeviceReplayMirror):
     Single-chip by design (the flat ring is not ``shard_map``'d); the shared
     ``device_replay_enabled(..., allow_dp=False)`` gate falls back to host sampling
     under data parallelism or multi-process meshes.
+
+    ``store_dtype`` (``buffer.store_dtype``): optional reduced-precision storage
+    for the float observation planes (``obs``/``next_obs``) — bf16 halves the
+    ring's HBM footprint; sampled batches cast back to the declared dtype
+    INSIDE the jit (one fused convert on the gathered rows, not on the ring).
     """
 
-    def __init__(self, capacity: int, n_envs: int, specs: Dict[str, Tuple[Sequence[int], Any]]):
+    def __init__(
+        self,
+        capacity: int,
+        n_envs: int,
+        specs: Dict[str, Tuple[Sequence[int], Any]],
+        store_dtype: Optional[Any] = None,
+    ):
         specs = dict(specs)
         if STAMP_KEY in specs:
             raise ValueError(f"spec key {STAMP_KEY!r} is reserved for the ring's write stamps")
         self._batch_keys = tuple(specs)
+        # Sampled batches come back at the key's DECLARED dtype; only the ring
+        # storage (and the scan writer's cast) uses store_dtype.
+        self._sample_cast: Dict[str, Any] = {}
+        if store_dtype is not None:
+            for k in STORE_DTYPE_KEYS:
+                if k in specs and jnp.issubdtype(jnp.dtype(specs[k][1]), jnp.floating):
+                    self._sample_cast[k] = specs[k][1]
+                    specs[k] = (specs[k][0], store_dtype)
+        self.store_dtype = store_dtype
         specs[STAMP_KEY] = ((1,), jnp.int32)
         super().__init__(capacity, n_envs, specs)
 
@@ -411,12 +449,15 @@ class DeviceTransitionRing(DeviceReplayMirror):
         a scanned train block.  ``batch[k]`` is ``[B, *row_shape]``."""
         shapes = {k: self._row_shapes[k] for k in self._batch_keys}
         batch_keys = self._batch_keys
+        sample_cast = dict(self._sample_cast)
 
         def sample_gather(arrays, filled, rows_added, key):
             envs, rows = self.sample_indices(filled, key, batch_size)
             batch = {}
             for k in batch_keys:
                 picked = arrays[k][envs, rows]  # [B, flat]
+                if k in sample_cast:  # store_dtype plane: cast the BATCH, not the ring
+                    picked = picked.astype(sample_cast[k])
                 batch[k] = picked.reshape(batch_size, *shapes[k])
             ages = (rows_added - 1) - arrays[STAMP_KEY][envs, rows, 0]
             age_metrics = {
@@ -434,7 +475,9 @@ def make_transition_ring(ctx, cfg, rb, specs: Dict[str, Tuple[Sequence[int], Any
     then keep host sampling + the async prefetcher)."""
     if not device_replay_enabled(ctx, cfg, allow_dp=False):
         return None
-    return DeviceTransitionRing(rb.buffer_size, rb.n_envs, specs)
+    return DeviceTransitionRing(
+        rb.buffer_size, rb.n_envs, specs, store_dtype=resolve_store_dtype(cfg.buffer.get("store_dtype"))
+    )
 
 
 def _data_axis_devices(mesh) -> list:

@@ -52,6 +52,7 @@ from sheeprl_tpu.envs.jax import make_jax_env
 from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import health_enabled
+from sheeprl_tpu.precision import train_policy
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.timer import timer
@@ -265,6 +266,9 @@ def make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key: str, re
     act_low = jnp.asarray(getattr(act_space, "low", 0.0), jnp.float32)
     act_high = jnp.asarray(getattr(act_space, "high", 0.0), jnp.float32)
     vstep = jax.vmap(env.step_autoreset, in_axes=(None, 0, 0, 0))
+    # Precision boundary (howto/precision.md): a CAST COPY of the obs feeds the
+    # acting forward; the stored trajectory keeps the env's f32 observations.
+    cast_obs = train_policy(cfg).cast_to_compute
 
     def iteration(carry, clip_coef, ent_coef):
         params = carry["params"]
@@ -273,7 +277,7 @@ def make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key: str, re
         def act_step(c, _):
             env_state, obs, key, stats = c
             key, k_act, k_step = jax.random.split(key, 3)
-            actor_out, value = agent.apply(params, {obs_key: obs})
+            actor_out, value = agent.apply(params, {obs_key: cast_obs(obs)})
             env_act, stored_act, logprob = sample_actions(k_act, actor_out, is_continuous)
             if clip_act:
                 env_actions = jnp.clip(env_act, act_low, act_high)
@@ -299,7 +303,7 @@ def make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key: str, re
         (env_state, obs, key, stats), traj = jax.lax.scan(
             act_step, (carry["env_state"], carry["obs"], carry["key"], stats0), None, length=rollout_steps
         )
-        _, next_value = agent.apply(params, {obs_key: obs})
+        _, next_value = agent.apply(params, {obs_key: cast_obs(obs)})
         returns, advantages = gae(
             traj["rewards"][..., None],
             traj["values"][..., None],
@@ -560,6 +564,9 @@ def make_sac_anakin_dispatch(env, env_params, actor, critic, cfg, act_space, rin
     rescale = bool(np.isfinite(act_space.low).all() and np.isfinite(act_space.high).all())
     vstep = jax.vmap(env.step_autoreset, in_axes=(None, 0, 0, 0))
     vsample = jax.vmap(env.sample_action, in_axes=(None, 0))
+    # Precision boundary: acting casts a COPY of the obs; ring rows keep the
+    # buffer's storage dtype (buffer.store_dtype handles the ring plane).
+    cast_obs = train_policy(cfg).cast_to_compute
 
     def builder(steps: int, grad_per_step: int, train: bool):
         def dispatch(carry):
@@ -567,7 +574,7 @@ def make_sac_anakin_dispatch(env, env_params, actor, critic, cfg, act_space, rin
                 params, o_state, env_state, obs, arrays, rows_added, gstep, key, stats = c
                 key, k_act, k_step = jax.random.split(key, 3)
                 if train:  # trace-time constant: prefill compiles its own program
-                    mean, log_std = actor.apply(params["actor"], obs)
+                    mean, log_std = actor.apply(params["actor"], cast_obs(obs))
                     tanh_act = actor.dist(mean, log_std).sample(k_act)
                 else:
                     raw = vsample(env_params, jax.random.split(k_act, num_envs))
@@ -688,7 +695,7 @@ def sac_anakin(ctx, cfg) -> None:
     (``engine/population.py``; howto/population.md)."""
     from sheeprl_tpu.algos.sac.agent import build_agent
     from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, test
-    from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+    from sheeprl_tpu.data.device_buffer import DeviceTransitionRing, resolve_store_dtype
     from sheeprl_tpu.engine.population import (
         PopulationSpec,
         member_keys,
@@ -742,6 +749,7 @@ def sac_anakin(ctx, cfg) -> None:
             "rewards": ((1,), jnp.float32),
             "dones": ((1,), jnp.float32),
         },
+        store_dtype=resolve_store_dtype(cfg.buffer.get("store_dtype")),
     )
     inject = tuple(n for n in ("actor", "critic", "alpha") if f"{n}.optimizer.lr" in pop.sweep)
     actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
@@ -995,7 +1003,7 @@ def replay_update(cfg, dump_dir, member: Optional[int] = None):
             carry, metrics = jax.jit(iteration)(staged, float(clip), float(ent))
     else:
         from sheeprl_tpu.algos.sac.agent import build_agent
-        from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+        from sheeprl_tpu.data.device_buffer import DeviceTransitionRing, resolve_store_dtype
 
         actor, critic, params0 = build_agent(ctx, act_space, obs_space, cfg)
         obs_dim = int(np.prod(obs_space[obs_key].shape))
@@ -1011,6 +1019,7 @@ def replay_update(cfg, dump_dir, member: Optional[int] = None):
                 "rewards": ((1,), jnp.float32),
                 "dones": ((1,), jnp.float32),
             },
+            store_dtype=resolve_store_dtype(cfg.buffer.get("store_dtype")),
         )
         inject = tuple(n for n in ("actor", "critic", "alpha") if f"{n}.optimizer.lr" in pop.sweep)
         actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
@@ -1066,7 +1075,7 @@ def lower_for_audit():
     from sheeprl_tpu.algos.sac.agent import build_agent as build_sac_agent
     from sheeprl_tpu.analysis.ir.synth import compose_tiny, tiny_ctx
     from sheeprl_tpu.analysis.ir.types import AuditEntry
-    from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+    from sheeprl_tpu.data.device_buffer import DeviceTransitionRing, resolve_store_dtype
 
     entries = []
 
@@ -1169,6 +1178,7 @@ def lower_for_audit():
             "rewards": ((1,), jnp.float32),
             "dones": ((1,), jnp.float32),
         },
+        store_dtype=resolve_store_dtype(cfg.buffer.get("store_dtype")),
     )
     actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
         env, env_params, actor, critic, cfg, act_space, ring, int(cfg.algo.per_rank_batch_size)
@@ -1211,6 +1221,124 @@ def lower_for_audit():
             args=(pop_carry,),
             covers=("anakin_sac_pop",),
             precision=str(cfg.mesh.precision),
+        )
+    )
+
+    # ----------------------------------------------------- bf16 algo.precision
+    # The same two dispatch programs with mesh.precision pinned to fp32 and the
+    # algo.precision=bf16 knob doing ALL the work — IR002 then proves the
+    # algo-level override alone puts bf16 on the dots (params stay f32; the
+    # existing entries above already exercise mesh-inherited bf16-mixed).
+    cfg = compose_tiny(
+        [
+            "exp=ppo",
+            "env=jax_cartpole",
+            "algo.anakin=True",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=4",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.mlp_features_dim=8",
+            "env.num_envs=2",
+            "mesh.precision=fp32",
+            "algo.precision=bf16",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    env, env_params = anakin_env(cfg)
+    obs_key = anakin_mlp_key(cfg)
+    obs_space = gym.spaces.Dict({obs_key: env.observation_space(env_params)})
+    act_space = env.action_space(env_params)
+    agent, params = build_ppo_agent(ctx, act_space, obs_space, cfg)
+    num_envs = int(cfg.env.num_envs)
+    fns = PPOTrainFns(ctx, agent, cfg, [obs_key], num_updates=4)
+    iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key)
+    env_state, obs0 = reset_envs(env, env_params, num_envs, jax.random.PRNGKey(1))
+    carry = {
+        "params": params,
+        "opt_state": fns.opt.init(params),
+        "env_state": env_state,
+        "obs": obs0,
+        "key": jax.random.PRNGKey(0),
+        "episode_stats": init_episode_stats(num_envs),
+    }
+    entries.append(
+        AuditEntry(
+            name="anakin/ppo_dispatch_bf16",
+            fn=jax.jit(iteration, donate_argnums=(0,)),
+            args=(carry, 0.2, 0.0),
+            covers=("anakin_ppo_bf16",),
+            precision="bf16",
+        )
+    )
+
+    cfg = compose_tiny(
+        [
+            "exp=sac",
+            "env=jax_pendulum",
+            "algo.anakin=True",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=4",
+            "algo.replay_ratio=1",
+            "env.num_envs=2",
+            "buffer.size=64",
+            "mesh.precision=fp32",
+            "algo.precision=bf16",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    env, env_params = anakin_env(cfg)
+    mlp_key = anakin_mlp_key(cfg)
+    obs_space_box = env.observation_space(env_params)
+    act_space = env.action_space(env_params)
+    obs_space = gym.spaces.Dict({mlp_key: obs_space_box})
+    actor, critic, params = build_sac_agent(ctx, act_space, obs_space, cfg)
+    params = jax.tree.map(jnp.copy, params)  # donation safety (critic_target aliases)
+    num_envs = int(cfg.env.num_envs)
+    obs_dim = int(np.prod(obs_space_box.shape))
+    act_dim = int(np.prod(act_space.shape))
+    capacity = max(int(cfg.buffer.size) // max(num_envs, 1), 1)
+    ring = DeviceTransitionRing(
+        capacity,
+        num_envs,
+        {
+            "obs": ((obs_dim,), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+        store_dtype=resolve_store_dtype(cfg.buffer.get("store_dtype")),
+    )
+    actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
+        env, env_params, actor, critic, cfg, act_space, ring, int(cfg.algo.per_rank_batch_size)
+    )
+    env_state, obs0 = reset_envs(env, env_params, num_envs, jax.random.PRNGKey(1))
+    carry = {
+        "params": params,
+        "opt_state": {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        },
+        "env_state": env_state,
+        "obs": obs0,
+        "ring": ring.arrays,
+        "rows_added": jnp.zeros((), jnp.int32),
+        "gstep": jnp.zeros((), jnp.int32),
+        "key": jax.random.PRNGKey(0),
+        "episode_stats": init_episode_stats(num_envs),
+    }
+    entries.append(
+        AuditEntry(
+            name="anakin/sac_dispatch_bf16",
+            fn=jax.jit(builder(2, 1, True), donate_argnums=(0,)),
+            args=(carry,),
+            covers=("anakin_sac_bf16",),
+            precision="bf16",
         )
     )
     return entries

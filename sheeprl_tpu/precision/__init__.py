@@ -1,0 +1,53 @@
+"""Precision tier: mixed-precision training policies and post-training quantization.
+
+Three layers, consumed by the rest of the framework:
+
+* :mod:`sheeprl_tpu.precision.policy` — jmp-style param/compute/output dtype
+  triples (``PrecisionPolicy``) resolved from ``algo.precision`` (train path)
+  with mesh inheritance, plus the boundary-cast helpers;
+* :mod:`sheeprl_tpu.precision.loss_scale` — NoOp/Static/Dynamic loss scaling
+  for fp16 (bf16 needs none: same exponent range as f32);
+* :mod:`sheeprl_tpu.precision.quantize` — int8 weight-only quantization with
+  per-output-channel scales (``Int8Weight`` pytree leaves, dequant-in-matmul)
+  for the serving hot path (``serve.precision=int8``);
+* :mod:`sheeprl_tpu.precision.parity` — the agreement/KL metrics the parity
+  tests and the serve parity stamp are built on.
+"""
+
+from sheeprl_tpu.precision.loss_scale import (
+    DynamicLossScale,
+    NoOpLossScale,
+    StaticLossScale,
+    all_finite,
+)
+from sheeprl_tpu.precision.parity import (
+    action_agreement,
+    action_agreement_mask,
+    categorical_kl,
+    gaussian_mean_divergence,
+)
+from sheeprl_tpu.precision.policy import PrecisionPolicy, resolve_policy, train_policy
+from sheeprl_tpu.precision.quantize import (
+    Int8Weight,
+    dequantize_params,
+    quantize_params,
+    quantize_weight,
+)
+
+__all__ = [
+    "PrecisionPolicy",
+    "resolve_policy",
+    "train_policy",
+    "NoOpLossScale",
+    "StaticLossScale",
+    "DynamicLossScale",
+    "all_finite",
+    "Int8Weight",
+    "quantize_weight",
+    "quantize_params",
+    "dequantize_params",
+    "action_agreement",
+    "action_agreement_mask",
+    "categorical_kl",
+    "gaussian_mean_divergence",
+]

@@ -1,0 +1,137 @@
+"""Loss scaling for fp16 training (jmp-style).
+
+bf16 shares f32's exponent range and needs none of this; the classes exist as
+a library so an fp16 tier can be wired without redesign. All three are
+pytree-registered so a scale can live inside a jitted train carry.
+
+Usage pattern (inside a jitted step)::
+
+    scaled_loss = scale.scale(loss_fn(params))
+    grads = jax.grad(...)(params)          # grads of the SCALED loss
+    grads = scale.unscale(grads)
+    finite = all_finite(grads)
+    scale = scale.adjust(finite)
+    params = lax.cond(finite, apply_update, keep_params, ...)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """True iff every float leaf of ``tree`` is finite everywhere."""
+    leaves = [x for x in jax.tree.leaves(tree) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(finite).all()
+
+
+@register_pytree_node_class
+class NoOpLossScale:
+    """Identity scaling — the policy for f32 and bf16 training."""
+
+    def scale(self, loss: jax.Array) -> jax.Array:
+        return loss
+
+    def unscale(self, tree: Any) -> Any:
+        return tree
+
+    def adjust(self, grads_finite: jax.Array) -> "NoOpLossScale":
+        del grads_finite
+        return self
+
+    def tree_flatten(self):
+        return (), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux, children
+        return cls()
+
+
+@register_pytree_node_class
+class StaticLossScale:
+    """Fixed multiplicative loss scale."""
+
+    def __init__(self, scale: Any):
+        self.loss_scale = jnp.asarray(scale, dtype=jnp.float32)
+
+    def scale(self, loss: jax.Array) -> jax.Array:
+        return loss * self.loss_scale.astype(loss.dtype)
+
+    def unscale(self, tree: Any) -> Any:
+        inv = (1.0 / self.loss_scale).astype(jnp.float32)
+        return jax.tree.map(lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), tree)
+
+    def adjust(self, grads_finite: jax.Array) -> "StaticLossScale":
+        del grads_finite
+        return self
+
+    def tree_flatten(self):
+        return (self.loss_scale,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        (scale,) = children
+        obj = cls.__new__(cls)
+        obj.loss_scale = scale
+        return obj
+
+
+@register_pytree_node_class
+class DynamicLossScale:
+    """Doubling/halving loss scale (jmp semantics).
+
+    On finite grads: after ``period`` consecutive finite steps the scale
+    doubles. On non-finite grads: the scale halves (floored at ``min_scale``)
+    and the counter resets. The caller is responsible for SKIPPING the update
+    when grads are not finite.
+    """
+
+    def __init__(self, scale: Any = 2.0**15, counter: Any = 0, period: int = 2000, factor: int = 2, min_scale: float = 1.0):
+        self.loss_scale = jnp.asarray(scale, dtype=jnp.float32)
+        self.counter = jnp.asarray(counter, dtype=jnp.int32)
+        self.period = int(period)
+        self.factor = int(factor)
+        self.min_scale = float(min_scale)
+
+    def scale(self, loss: jax.Array) -> jax.Array:
+        return loss * self.loss_scale.astype(loss.dtype)
+
+    def unscale(self, tree: Any) -> Any:
+        inv = (1.0 / self.loss_scale).astype(jnp.float32)
+        return jax.tree.map(lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), tree)
+
+    def adjust(self, grads_finite: jax.Array) -> "DynamicLossScale":
+        grow = self.counter == (self.period - 1)
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(grow, self.loss_scale * self.factor, self.loss_scale),
+            jnp.maximum(self.loss_scale / self.factor, self.min_scale),
+        )
+        new_counter = jnp.where(grads_finite, jnp.where(grow, 0, self.counter + 1), 0).astype(jnp.int32)
+        return DynamicLossScale(
+            scale=new_scale, counter=new_counter, period=self.period, factor=self.factor, min_scale=self.min_scale
+        )
+
+    def tree_flatten(self):
+        return (self.loss_scale, self.counter), (self.period, self.factor, self.min_scale)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        period, factor, min_scale = aux
+        scale, counter = children
+        obj = cls.__new__(cls)
+        obj.loss_scale = scale
+        obj.counter = counter
+        obj.period = period
+        obj.factor = factor
+        obj.min_scale = min_scale
+        return obj

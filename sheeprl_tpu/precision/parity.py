@@ -1,0 +1,73 @@
+"""Parity metrics between two policies at different precisions.
+
+Used by the parity tests AND by the serve startup parity stamp
+(``serve.precision != f32`` loads an f32 reference and records agreement).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def action_agreement(
+    actions_a: Any,
+    actions_b: Any,
+    continuous: bool = False,
+    atol: float = 1e-2,
+) -> float:
+    """Fraction of rows on which two policies pick the same greedy action.
+
+    Discrete actions must match exactly; continuous actions agree when every
+    component is within ``atol``. Inputs are ``[batch, ...]`` arrays (or lists
+    thereof for multi-discrete — compared per-component then ANDed).
+    """
+    if isinstance(actions_a, (list, tuple)):
+        per = [
+            np.asarray(action_agreement_mask(a, b, continuous=continuous, atol=atol))
+            for a, b in zip(actions_a, actions_b)
+        ]
+        mask = np.logical_and.reduce(per)
+        return float(mask.mean())
+    mask = action_agreement_mask(actions_a, actions_b, continuous=continuous, atol=atol)
+    return float(np.asarray(mask).mean())
+
+
+def action_agreement_mask(
+    actions_a: jax.Array,
+    actions_b: jax.Array,
+    continuous: bool = False,
+    atol: float = 1e-2,
+) -> np.ndarray:
+    """Boolean per-row agreement mask (see :func:`action_agreement`)."""
+    a = np.asarray(jax.device_get(actions_a))
+    b = np.asarray(jax.device_get(actions_b))
+    if continuous:
+        close = np.abs(a.astype(np.float64) - b.astype(np.float64)) <= atol
+        return close.reshape(close.shape[0], -1).all(axis=-1)
+    return (a.reshape(a.shape[0], -1) == b.reshape(b.shape[0], -1)).all(axis=-1)
+
+
+def categorical_kl(logits_p: jax.Array, logits_q: jax.Array) -> float:
+    """Mean KL(p || q) between two batches of categorical logits, in nats."""
+    p32 = jnp.asarray(logits_p, dtype=jnp.float32)
+    q32 = jnp.asarray(logits_q, dtype=jnp.float32)
+    logp = jax.nn.log_softmax(p32, axis=-1)
+    logq = jax.nn.log_softmax(q32, axis=-1)
+    kl = jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+    return float(jnp.mean(kl))
+
+
+def gaussian_mean_divergence(
+    mean_p: jax.Array, mean_q: jax.Array, log_std_p: Optional[jax.Array] = None
+) -> float:
+    """Mean absolute divergence of continuous policy means, normalised by the
+    reference std when available (a cheap stand-in for KL on tanh-squashed
+    policies whose exact KL has no closed form)."""
+    d = jnp.abs(jnp.asarray(mean_p, jnp.float32) - jnp.asarray(mean_q, jnp.float32))
+    if log_std_p is not None:
+        d = d / jnp.maximum(jnp.exp(jnp.asarray(log_std_p, jnp.float32)), 1e-6)
+    return float(jnp.mean(d))

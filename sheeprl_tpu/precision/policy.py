@@ -1,0 +1,128 @@
+"""Mixed-precision policies: param/compute/output dtype triples à la jmp.
+
+The contract (documented in ``howto/precision.md``):
+
+* **params** stay in ``param_dtype`` (f32 for every mixed policy) — flax
+  modules built with ``dtype=compute_dtype`` but default ``param_dtype``
+  already do this, so optimizer state stays f32 too;
+* **compute** (matmuls, activations) runs in ``compute_dtype`` — flax's
+  ``promote_dtype`` casts inputs and kernel to ``dtype`` inside each layer,
+  and the train-fn builders additionally cast float observation batches at
+  the loss boundary so the first matmul's operands are already low-precision;
+* **outputs** (logits, values, losses, anything reduced) are cast back to
+  ``output_dtype`` (f32) — the agent heads do this with ``.astype``.
+
+``train_policy(cfg, ctx)`` is the single resolution point for the train
+path: ``algo.precision`` defaults to ``"mesh"`` (inherit ``mesh.precision``,
+the pre-existing behavior), or forces ``"f32"``/``"bf16"`` per-run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """A (param, compute, output) dtype triple with boundary-cast helpers.
+
+    The cast helpers touch only floating-point leaves — integer/bool leaves
+    (discrete actions, done flags, ring cursors) pass through untouched.
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    def _cast(self, tree: Any, dtype: Any) -> Any:
+        def leaf(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(dtype)
+            return x
+
+        return jax.tree.map(leaf, tree)
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        return self._cast(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree: Any) -> Any:
+        return self._cast(tree, self.param_dtype)
+
+    def cast_to_output(self, tree: Any) -> Any:
+        return self._cast(tree, self.output_dtype)
+
+    @property
+    def is_mixed(self) -> bool:
+        return self.compute_dtype != self.param_dtype
+
+    def describe(self) -> str:
+        return (
+            f"params={jnp.dtype(self.param_dtype).name} "
+            f"compute={jnp.dtype(self.compute_dtype).name} "
+            f"output={jnp.dtype(self.output_dtype).name}"
+        )
+
+
+_POLICIES = {
+    # full precision
+    "f32": (jnp.float32, jnp.float32, jnp.float32),
+    "fp32": (jnp.float32, jnp.float32, jnp.float32),
+    "float32": (jnp.float32, jnp.float32, jnp.float32),
+    "32-true": (jnp.float32, jnp.float32, jnp.float32),
+    # bf16 mixed: f32 params/optimizer state, bf16 compute, f32 outputs
+    "bf16": (jnp.float32, jnp.bfloat16, jnp.float32),
+    "bf16-mixed": (jnp.float32, jnp.bfloat16, jnp.float32),
+    # bf16 true: everything bf16 (params included)
+    "bf16-true": (jnp.bfloat16, jnp.bfloat16, jnp.bfloat16),
+    # fp16 mixed: needs loss scaling (see train_policy's guard)
+    "fp16": (jnp.float32, jnp.float16, jnp.float32),
+    "16-mixed": (jnp.float32, jnp.float16, jnp.float32),
+}
+
+
+def resolve_policy(spec: str) -> PrecisionPolicy:
+    """Map a precision string (``algo.precision`` / ``mesh.precision``) to a policy."""
+    key = str(spec).lower()
+    if key not in _POLICIES:
+        raise ValueError(
+            f"Unknown precision spec {spec!r}; expected one of {sorted(_POLICIES)}"
+        )
+    param, compute, output = _POLICIES[key]
+    return PrecisionPolicy(param_dtype=param, compute_dtype=compute, output_dtype=output)
+
+
+def train_policy(cfg: Any, ctx: Optional[Any] = None) -> PrecisionPolicy:
+    """Resolve the training-path precision policy from ``cfg.algo.precision``.
+
+    ``"mesh"`` (the default) inherits ``mesh.precision`` — via ``ctx.precision``
+    when a MeshContext is at hand (it may have been overridden at construction),
+    else from the config tree — preserving the pre-existing behavior where the
+    mesh knob alone picked the compute dtype. An EXPLICIT ``algo.precision=fp16``
+    is rejected: fp16's narrow exponent range requires threading a
+    ``DynamicLossScale`` state through every donated carry (breaking checkpoint
+    layouts and the Anakin dispatch signature), and TPUs want bf16 anyway.
+    Mesh-inherited fp16 passes through for legacy configs.
+    """
+    algo = cfg.get("algo") if hasattr(cfg, "get") else None
+    spec = "mesh"
+    if algo is not None:
+        spec = str(algo.get("precision", "mesh") or "mesh")
+    if spec.lower() == "mesh":
+        if ctx is not None:
+            mesh_spec = str(ctx.precision)
+        else:
+            mesh_spec = str((cfg.get("mesh") or {}).get("precision", "fp32"))
+        return resolve_policy(mesh_spec)
+    policy = resolve_policy(spec)
+    if policy.compute_dtype == jnp.float16:
+        raise ValueError(
+            "algo.precision=fp16 is not supported: fp16 training requires dynamic "
+            "loss scaling state in every train carry (sheeprl_tpu.precision."
+            "loss_scale.DynamicLossScale is available as a library), which would "
+            "change checkpoint layouts. Use algo.precision=bf16 on TPU instead."
+        )
+    return policy

@@ -1,0 +1,86 @@
+"""Post-training int8 weight quantization for the serving hot path.
+
+Weight-only, per-output-channel symmetric quantization: each 2-D kernel
+``w[in, out]`` becomes an :class:`Int8Weight` pytree leaf holding ``q`` (int8)
+and a ``[1, out]`` f32 ``scale`` where ``q = round(w / scale)`` and
+``scale = max(|w|, axis=in) / 127``. Dequantization (``q.astype(f32) * scale``)
+happens INSIDE the jitted act fn — XLA fuses the convert+multiply into the
+consuming dot, so HBM holds int8 weights (4× smaller than f32) while the MXU
+still sees its native dtype. Biases, LayerNorm scales and every non-2-D leaf
+stay in their original dtype: they are a rounding error of the working set
+and quantizing them costs accuracy for nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+# Smallest representable scale: avoids div-by-zero on all-zero channels.
+_MIN_SCALE = 1e-8
+
+
+@register_pytree_node_class
+class Int8Weight:
+    """An int8 kernel + per-output-channel f32 scale, as one pytree leaf pair."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def dequantize(self, dtype: Any = jnp.float32) -> jax.Array:
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        q, scale = children
+        return cls(q, scale)
+
+    def __repr__(self) -> str:
+        return f"Int8Weight(shape={tuple(self.q.shape)})"
+
+
+def quantize_weight(w: jax.Array) -> Int8Weight:
+    """Quantize one ``[in, out]`` float kernel to int8 with per-out-channel scales."""
+    w32 = jnp.asarray(w, dtype=jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(w32), axis=0, keepdims=True) / 127.0, _MIN_SCALE)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return Int8Weight(q=q, scale=scale)
+
+
+def quantize_params(params: Any) -> Any:
+    """Replace every 2-D float leaf (Dense kernels) with an :class:`Int8Weight`."""
+
+    def leaf(x):
+        if hasattr(x, "ndim") and x.ndim == 2 and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return quantize_weight(x)
+        return x
+
+    return jax.tree.map(leaf, params)
+
+
+def dequantize_params(params: Any, dtype: Any = jnp.float32) -> Any:
+    """Expand :class:`Int8Weight` leaves back to float — call INSIDE jit so XLA
+    fuses the dequant into the consuming matmul."""
+
+    def leaf(x):
+        if isinstance(x, Int8Weight):
+            return x.dequantize(dtype)
+        return x
+
+    return jax.tree.map(leaf, params, is_leaf=lambda x: isinstance(x, Int8Weight))

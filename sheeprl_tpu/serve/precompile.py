@@ -129,4 +129,59 @@ def lower_for_audit():
             precision=str(sac_cfg.mesh.precision),
         )
     )
+
+    # int8 weights-only tier (serve.precision=int8): the same act programs with
+    # every 2-D kernel stored as Int8Weight and dequantized in-jit — audited as
+    # their own programs because the dequant must fuse into the dots (IR006)
+    # and the params pytree shape the ladder compiles against changes.  Built at
+    # f32 (mesh.precision=fp32) exactly like the server's int8 path.
+    for exp, overrides, act_space in (
+        (
+            "ppo",
+            [
+                "exp=ppo",
+                "env=discrete_dummy",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.cnn_keys.encoder=[]",
+                "algo.dense_units=8",
+                "algo.mlp_layers=1",
+                "algo.encoder.mlp_features_dim=8",
+                "mesh.precision=fp32",
+            ],
+            discrete_act_space(),
+        ),
+        (
+            "sac",
+            [
+                "exp=sac",
+                "env=continuous_dummy",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.hidden_size=8",
+                "mesh.precision=fp32",
+            ],
+            box_act_space(),
+        ),
+    ):
+        entries.append(_int8_entry(exp, overrides, act_space, bucket))
     return entries
+
+
+def _int8_entry(exp, overrides, act_space, bucket):
+    """One quantized act-dispatch audit entry (each call jits a distinct
+    program — no shared cache to thrash)."""
+    import jax
+
+    from sheeprl_tpu.analysis.ir.synth import compose_tiny, tiny_ctx, vector_space
+    from sheeprl_tpu.analysis.ir.types import AuditEntry
+    from sheeprl_tpu.utils.policy import build_policy, wrap_policy_precision
+
+    cfg = compose_tiny(overrides)
+    policy, _ = build_policy(tiny_ctx(cfg), cfg, vector_space(), act_space, greedy=True)
+    policy = wrap_policy_precision(policy, "int8")
+    return AuditEntry(
+        name=f"serve/{exp}_act_int8",
+        fn=jax.jit(policy.act_fn),
+        args=(policy.params, policy.zero_obs(bucket), zero_key()),
+        covers=(f"serve_{exp}_int8",),
+        precision="int8",
+    )

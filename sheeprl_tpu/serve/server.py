@@ -116,6 +116,8 @@ class PolicyServer:
         self.drain_timeout_s = float(serve_cfg.drain_timeout_s)
         self.log_every_s = float(serve_cfg.log_every_s)
         self.greedy = bool(serve_cfg.greedy)
+        self.precision = _normalize_precision(serve_cfg.get("precision", "f32"))
+        self.parity: Dict[str, Dict[str, Any]] = {}  # canonical -> parity stamp
         self._draining = False
         self._stop = threading.Event()
         self._channels: List[Channel] = []
@@ -141,7 +143,7 @@ class PolicyServer:
         from sheeprl_tpu.obs.watchdog import RecompileWatchdog
         from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
         from sheeprl_tpu.utils.model_manager import build_model_manager
-        from sheeprl_tpu.utils.policy import load_policy
+        from sheeprl_tpu.utils.policy import load_policy, parity_stamp
 
         specs = list(self.serve_cfg.policies)
         if not specs:
@@ -169,7 +171,25 @@ class PolicyServer:
             ctx = MeshContext(
                 mesh=build_mesh(devices=jax.devices()[:1]), precision=precision, seed=seed
             )
-            policy = load_policy(ctx, run_cfg, str(payload_dir), greedy=self.greedy)
+            policy = load_policy(
+                ctx, run_cfg, str(payload_dir), greedy=self.greedy, precision=self.precision
+            )
+            if self.precision != "f32":
+                # Parity stamp: reload at f32 (fresh run cfg — load_policy mutates
+                # it) and compare greedy actions on seeded random obs.  Runs
+                # before mark_warm, so its compiles are startup work, not
+                # watchdog violations.
+                reference = load_policy(
+                    MeshContext(
+                        mesh=build_mesh(devices=jax.devices()[:1]), precision=precision, seed=seed
+                    ),
+                    load_config(run_cfg_path),
+                    str(payload_dir),
+                    greedy=self.greedy,
+                    precision="f32",
+                )
+                self.parity[canonical] = parity_stamp(policy, reference, seed=seed)
+                print(f"[serve] {canonical}: parity {self.parity[canonical]}", flush=True)
             compiled, secs = precompile_ladder(policy, ladder)
             self.precompile_seconds += secs
             ep = _Endpoint(
@@ -274,6 +294,8 @@ class PolicyServer:
                 policies=sorted(self.endpoints),
                 aliases=sorted(self.aliases),
                 draining=bool(self._draining),
+                precision=self.precision,
+                parity=self.parity,
             )
             return
         if kind != "act":
@@ -423,6 +445,8 @@ class PolicyServer:
             "policies": sorted(self.endpoints),
             "startup_seconds": self.startup_seconds,
             "precompile_seconds": self.precompile_seconds,
+            "precision": self.precision,
+            "parity": self.parity,
         }
         _atomic_write_json(Path(ready), doc)
 
@@ -446,6 +470,8 @@ class PolicyServer:
             "recompiles": int(self.watchdog.recompiles) if self.watchdog else 0,
             "startup_seconds": self.startup_seconds,
             "precompile_seconds": self.precompile_seconds,
+            "precision": self.precision,
+            "parity": self.parity,
             "policies": per_policy,
         }
 
@@ -454,6 +480,18 @@ class PolicyServer:
         if not path:
             return
         _atomic_write_json(Path(path), self.summary(preempted=preempted))
+
+
+def _normalize_precision(spec: Any) -> str:
+    """serve.precision → canonical tier name (f32 | bf16 | int8)."""
+    key = str(spec if spec is not None else "f32").lower()
+    if key in ("", "none", "null", "f32", "fp32", "float32"):
+        return "f32"
+    if key in ("bf16", "bfloat16"):
+        return "bf16"
+    if key == "int8":
+        return "int8"
+    raise ValueError(f"Unknown serve.precision {spec!r}; expected f32, bf16 or int8")
 
 
 def _atomic_write_json(path: Path, doc: Dict[str, Any]) -> None:

@@ -78,6 +78,7 @@ class LoadedPolicy:
     is_continuous: bool
     action_dims: List[int]
     cfg: Any = field(repr=False, default=None)
+    precision: str = "f32"  # serving precision tier: f32 | bf16 | int8
 
     def zero_obs(self, batch: int) -> Dict[str, np.ndarray]:
         """A zero-filled obs batch matching the template (precompile ladders)."""
@@ -173,13 +174,93 @@ def build_policy(ctx, cfg, obs_space, act_space, greedy: bool = True) -> Tuple[L
     return policy, params
 
 
-def load_policy(ctx, cfg, ckpt_path: str, greedy: bool = True) -> LoadedPolicy:
+def wrap_policy_precision(policy: LoadedPolicy, precision: Any) -> LoadedPolicy:
+    """Apply a serving precision tier to a freshly built/loaded policy in place.
+
+    * ``f32`` (or null) — no-op, the checkpoint serves verbatim;
+    * ``bf16`` — float param leaves cast to bfloat16 (the act fn's compute dtype
+      must already be bf16: :func:`load_policy` forces ``algo.precision`` before
+      the agent build);
+    * ``int8`` — every 2-D float kernel is replaced by a per-channel symmetric
+      :class:`~sheeprl_tpu.precision.quantize.Int8Weight` and the act fn
+      dequantizes in-jit, so XLA fuses the dequant into the matmul
+      (weights-only quantization; activations stay float).
+    """
+    key = str(precision if precision is not None else "f32").lower()
+    if key in ("", "none", "null", "f32", "fp32", "float32"):
+        policy.precision = "f32"
+        return policy
+    if key in ("bf16", "bfloat16"):
+        import jax
+        import jax.numpy as jnp
+
+        policy.params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            policy.params,
+        )
+        policy.precision = "bf16"
+        return policy
+    if key == "int8":
+        from sheeprl_tpu.precision import dequantize_params, quantize_params
+
+        policy.params = quantize_params(policy.params)
+        base_act_fn = policy.act_fn
+
+        def act_fn(params, obs, key):
+            return base_act_fn(dequantize_params(params), obs, key)
+
+        policy.act_fn = act_fn
+        policy.precision = "int8"
+        return policy
+    raise ValueError(f"Unknown serve precision {precision!r}; expected f32, bf16 or int8")
+
+
+def parity_stamp(policy: LoadedPolicy, reference: LoadedPolicy, n_obs: int = 256, seed: int = 0) -> Dict[str, Any]:
+    """Greedy-action agreement between a reduced-precision policy and its f32
+    reference on seeded random observations — the parity report the server
+    stamps into ready_file / pong / the exit summary (howto/precision.md)."""
+    import jax
+
+    from sheeprl_tpu.precision import action_agreement
+
+    rng = np.random.default_rng(seed)
+    obs: Dict[str, np.ndarray] = {}
+    for k, (shape, dtype) in policy.obs_template.items():
+        if np.issubdtype(np.dtype(dtype), np.integer):
+            obs[k] = rng.integers(0, 256, size=(n_obs, *shape)).astype(np.dtype(dtype))
+        else:
+            obs[k] = rng.standard_normal((n_obs, *shape)).astype(np.dtype(dtype))
+    key = np.zeros((2,), np.uint32)
+    got = jax.device_get(jax.jit(policy.act_fn)(policy.params, obs, key))
+    want = jax.device_get(jax.jit(reference.act_fn)(reference.params, obs, key))
+    return {
+        "precision": policy.precision,
+        "reference": reference.precision,
+        "n_obs": int(n_obs),
+        "action_agreement": float(
+            action_agreement(want, got, continuous=policy.is_continuous)
+        ),
+    }
+
+
+def load_policy(
+    ctx, cfg, ckpt_path: str, greedy: bool = True, precision: Optional[str] = None
+) -> LoadedPolicy:
     """The full pipeline: spaces from the run's env, agent rebuild, checkpoint
     load (checksum-verified), param extraction, device placement.
 
     ``cfg`` is the run's saved config (mutated: video capture and env count are
     forced to the single-env serve/eval shape before the env is instantiated to
     read its spaces).
+
+    ``precision`` is the serve-tier override (``serve.precision``): ``None``
+    keeps the run config's own ``algo.precision`` resolution (eval parity with
+    training); ``f32``/``bf16``/``int8`` pin the act fn's tier — ``bf16`` builds
+    the agent at bf16 compute and casts the loaded params, ``f32``/``int8``
+    force a full-precision build (int8 then quantizes the loaded kernels, see
+    :func:`wrap_policy_precision`).
     """
     import jax
 
@@ -188,6 +269,9 @@ def load_policy(ctx, cfg, ckpt_path: str, greedy: bool = True) -> LoadedPolicy:
 
     cfg.env.capture_video = False
     cfg.env.num_envs = 1
+    if precision is not None:
+        key = str(precision).lower()
+        cfg.algo.precision = "bf16" if key in ("bf16", "bfloat16") else "f32"
     env = make_env(cfg, cfg.seed, 0, None, "serve")()
     obs_space = env.observation_space
     act_space = env.action_space
@@ -201,4 +285,6 @@ def load_policy(ctx, cfg, ckpt_path: str, greedy: bool = True) -> LoadedPolicy:
     if policy.family == "sac":
         params = params["actor"]
     policy.params = ctx.replicate(params)
+    if precision is not None:
+        policy = wrap_policy_precision(policy, precision)
     return policy

@@ -59,8 +59,9 @@ def test_ir_audit_full_registry_covers_all_entry_points(monkeypatch):
     for entry in build_entries():
         covered.update(entry.covers)
     assert EXPECTED_COVERAGE <= covered, sorted(EXPECTED_COVERAGE - covered)
-    # the 14 entry points + 2 anakin dispatches (p2e finetuning rides the
-    # dreamer-family builders on top)
-    assert len(EXPECTED_COVERAGE) == 16
+    # the 14 entry points + 4 anakin dispatches (plain + population for ppo/sac)
+    # + 2 serve act programs + 4 precision-tier programs (bf16 anakin, int8
+    # serve); p2e finetuning rides the dreamer-family builders on top
+    assert len(EXPECTED_COVERAGE) == 24
     monkeypatch.chdir(REPO_ROOT)
     assert ir_main(["-q"]) == 0

@@ -1,0 +1,134 @@
+"""``buffer.store_dtype=bf16``: the ring's reduced-precision observation planes
+(howto/precision.md, satellite of the precision tier).
+
+Only ``obs``/``next_obs`` store at bf16 (STORE_DTYPE_KEYS); everything else is
+bit-identical to a full-precision ring.  Sampled batches come back at the keys'
+DECLARED dtype (f32) and must match the f32-stored ring within one bf16
+rounding step — through both write paths (host ``add_step`` and the in-scan
+writer) and the in-jit sample gather.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.device_buffer import (
+    STORE_DTYPE_KEYS,
+    DeviceTransitionRing,
+    resolve_store_dtype,
+)
+
+# bf16 has 8 mantissa bits: relative rounding error <= 2^-8 on O(1) values.
+BF16_ATOL = 2 ** -7
+
+
+def _specs(obs_dim=6, act_dim=2):
+    return {
+        "obs": ((obs_dim,), jnp.float32),
+        "next_obs": ((obs_dim,), jnp.float32),
+        "actions": ((act_dim,), jnp.float32),
+        "rewards": ((1,), jnp.float32),
+        "dones": ((1,), jnp.float32),
+    }
+
+
+def _step(rng, n_envs, obs_dim=6, act_dim=2):
+    return {
+        "obs": rng.standard_normal((1, n_envs, obs_dim)).astype(np.float32),
+        "next_obs": rng.standard_normal((1, n_envs, obs_dim)).astype(np.float32),
+        "actions": rng.standard_normal((1, n_envs, act_dim)).astype(np.float32),
+        "rewards": rng.standard_normal((1, n_envs, 1)).astype(np.float32),
+        "dones": np.zeros((1, n_envs, 1), np.float32),
+    }
+
+
+def _twin_rings(capacity=16, n_envs=2):
+    """(f32-stored ring, bf16-stored ring) over identical specs."""
+    return (
+        DeviceTransitionRing(capacity, n_envs, _specs()),
+        DeviceTransitionRing(capacity, n_envs, _specs(), store_dtype=jnp.bfloat16),
+    )
+
+
+def test_resolve_store_dtype_spellings_and_unknown():
+    for spec in (None, "", "none", "null", "f32", "fp32", "float32"):
+        assert resolve_store_dtype(spec) is None
+    assert resolve_store_dtype("bf16") is jnp.bfloat16
+    assert resolve_store_dtype("bfloat16") is jnp.bfloat16
+    with pytest.raises(ValueError, match="fp8"):
+        resolve_store_dtype("fp8")
+
+
+def test_only_obs_planes_store_reduced():
+    _, ring = _twin_rings()
+    for k in STORE_DTYPE_KEYS:
+        assert ring.arrays[k].dtype == jnp.bfloat16
+    for k in ("actions", "rewards", "dones"):
+        assert ring.arrays[k].dtype == jnp.float32
+
+
+def test_add_step_and_gather_parity_with_f32_ring():
+    n_envs, cap = 2, 16
+    rng = np.random.default_rng(0)
+    full, half = _twin_rings(cap, n_envs)
+
+    for t in range(20):  # wraps the ring
+        step = _step(rng, n_envs)
+        full.add_step(step, position=t, rows_added=t)
+        half.add_step(step, position=t, rows_added=t)
+
+    key = jax.random.PRNGKey(0)
+    filled = jnp.asarray(cap, jnp.int32)
+    rows_added = jnp.asarray(20, jnp.int32)
+    batch_f32, ages_f32 = jax.jit(full.make_sample_gather(8))(full.arrays, filled, rows_added, key)
+    batch_bf16, ages_bf16 = jax.jit(half.make_sample_gather(8))(half.arrays, filled, rows_added, key)
+
+    # sampled batches come back at the DECLARED dtype on both rings
+    for k, batch in (("full", batch_f32), ("half", batch_bf16)):
+        del k
+        for arr in batch.values():
+            assert arr.dtype == jnp.float32
+
+    # non-obs planes are bit-identical; obs planes within one bf16 rounding step
+    for k in ("actions", "rewards", "dones"):
+        np.testing.assert_array_equal(np.asarray(batch_f32[k]), np.asarray(batch_bf16[k]))
+    for k in STORE_DTYPE_KEYS:
+        np.testing.assert_allclose(
+            np.asarray(batch_f32[k]), np.asarray(batch_bf16[k]), atol=BF16_ATOL, rtol=BF16_ATOL
+        )
+        assert not np.array_equal(np.asarray(batch_f32[k]), np.asarray(batch_bf16[k])), (
+            "bf16 storage should actually round — identical planes mean the cast never happened"
+        )
+
+    # same indices were drawn (same key), so staleness metrics agree exactly
+    for k in ages_f32:
+        np.testing.assert_array_equal(np.asarray(ages_f32[k]), np.asarray(ages_bf16[k]))
+
+
+def test_scan_writer_round_trip_casts_on_write_and_back_on_sample():
+    n_envs, cap = 2, 8
+    rng = np.random.default_rng(1)
+    _, ring = _twin_rings(cap, n_envs)
+    write = ring.make_scan_writer()
+
+    arrays = ring.arrays
+    expect_obs = None
+    for t in range(cap):
+        step = _step(rng, n_envs)
+        rows = {k: jnp.asarray(v[0]) for k, v in step.items()}
+        arrays = jax.jit(write)(arrays, rows, jnp.asarray(t, jnp.int32))
+        if t == cap - 1:
+            expect_obs = step["obs"][0]
+
+    assert arrays["obs"].dtype == jnp.bfloat16  # the writer casts to storage dtype
+
+    gather = ring.make_sample_gather(4)
+    batch, _ = jax.jit(gather)(
+        arrays, jnp.asarray(cap, jnp.int32), jnp.asarray(cap, jnp.int32), jax.random.PRNGKey(2)
+    )
+    assert batch["obs"].dtype == jnp.float32
+
+    # the last written row survives the bf16 round trip within one rounding step
+    last = np.asarray(arrays["obs"][:, cap - 1].astype(jnp.float32)).reshape(n_envs, -1)
+    np.testing.assert_allclose(last, expect_obs.reshape(n_envs, -1), atol=BF16_ATOL, rtol=BF16_ATOL)

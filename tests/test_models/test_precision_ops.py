@@ -1,0 +1,102 @@
+"""bf16 parity for the fused Pallas ops (howto/precision.md satellite).
+
+Both kernels upcast to f32 in VMEM and cast back to the state dtype on the way
+out, so feeding bf16 operands must track the f32 XLA reference within bf16
+rounding — forward AND the hand-derived VJPs.  Off-TPU this runs the kernels in
+interpreter mode: the exact code path the TPU executes, minus Mosaic.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.ops.gru import fused_layernorm_gru, reference_layernorm_gru
+from sheeprl_tpu.ops.rssm_step import fused_gru_step, reference_gru_step
+
+# bf16 has an 8-bit mantissa (~0.4% relative); the chained gate nonlinearities
+# keep everything O(1) so absolute tolerances are meaningful.
+FWD_ATOL = 2e-2
+GRAD_ATOL = 6e-2
+
+
+def _gru_operands(rng, batch=8, hidden=128, dtype=jnp.bfloat16):
+    proj = jnp.asarray(rng.normal(size=(batch, 3 * hidden)).astype(np.float32))
+    h = jnp.asarray(rng.normal(size=(batch, hidden)).astype(np.float32))
+    gamma = jnp.asarray(rng.normal(1.0, 0.1, size=(3 * hidden,)).astype(np.float32))
+    beta = jnp.asarray(rng.normal(0.0, 0.1, size=(3 * hidden,)).astype(np.float32))
+    f32 = (proj, h, gamma, beta)
+    return tuple(x.astype(dtype) for x in f32), f32
+
+
+def _step_operands(rng, batch=8, k=96, hidden=64, dtype=jnp.bfloat16):
+    xh = jnp.asarray(rng.normal(size=(batch, k)).astype(np.float32))
+    h = jnp.asarray(rng.normal(size=(batch, hidden)).astype(np.float32))
+    w = jnp.asarray(rng.normal(scale=k**-0.5, size=(k, 3 * hidden)).astype(np.float32))
+    gamma = jnp.asarray(rng.normal(1.0, 0.1, size=(3 * hidden,)).astype(np.float32))
+    beta = jnp.asarray(rng.normal(0.0, 0.1, size=(3 * hidden,)).astype(np.float32))
+    f32 = (xh, h, w, gamma, beta)
+    return tuple(x.astype(dtype) for x in f32), f32
+
+
+def test_fused_gru_bf16_forward_tracks_f32_reference():
+    bf16, f32 = _gru_operands(np.random.default_rng(0))
+    out = fused_layernorm_gru(*bf16)
+    assert out.dtype == jnp.bfloat16
+    ref = reference_layernorm_gru(*f32)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=FWD_ATOL
+    )
+
+
+def test_fused_gru_bf16_vjp_tracks_f32_reference():
+    bf16, f32 = _gru_operands(np.random.default_rng(1))
+
+    def loss(fn, args):
+        return jnp.sum(fn(*args).astype(jnp.float32))
+
+    grads = jax.grad(lambda *a: loss(fused_layernorm_gru, a), argnums=(0, 1, 2, 3))(*bf16)
+    ref = jax.grad(lambda *a: loss(reference_layernorm_gru, a), argnums=(0, 1, 2, 3))(*f32)
+    for g, r, name in zip(grads, ref, ["proj", "h", "gamma", "beta"]):
+        assert g.dtype == jnp.bfloat16, name
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32), atol=GRAD_ATOL, err_msg=name
+        )
+
+
+def test_fused_rssm_step_bf16_forward_tracks_f32_reference():
+    bf16, f32 = _step_operands(np.random.default_rng(2))
+    out = fused_gru_step(*bf16)
+    assert out.dtype == jnp.bfloat16
+    ref = reference_gru_step(*f32)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=FWD_ATOL
+    )
+
+
+def test_fused_rssm_step_bf16_vjp_tracks_f32_reference():
+    bf16, f32 = _step_operands(np.random.default_rng(3))
+
+    def loss(fn, args):
+        return jnp.sum(fn(*args).astype(jnp.float32))
+
+    grads = jax.grad(lambda *a: loss(fused_gru_step, a), argnums=(0, 1, 2, 3, 4))(*bf16)
+    ref = jax.grad(lambda *a: loss(reference_gru_step, a), argnums=(0, 1, 2, 3, 4))(*f32)
+    for g, r, name in zip(grads, ref, ["xh", "h", "w", "gamma", "beta"]):
+        assert g.dtype == jnp.bfloat16, name
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32), atol=GRAD_ATOL, err_msg=name
+        )
+
+
+@pytest.mark.parametrize("batch", [8, 16])
+def test_fused_gru_bf16_matches_its_own_f32_run(batch):
+    """The kernel's bf16 result must equal its OWN f32 result within rounding —
+    pins that precision loss comes only from the operand dtype, not a divergent
+    code path."""
+    bf16, f32 = _gru_operands(np.random.default_rng(4), batch=batch)
+    np.testing.assert_allclose(
+        np.asarray(fused_layernorm_gru(*bf16), np.float32),
+        np.asarray(fused_layernorm_gru(*f32), np.float32),
+        atol=FWD_ATOL,
+    )

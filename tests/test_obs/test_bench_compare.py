@@ -104,6 +104,33 @@ def test_serve_direction_pins_exact_name_beats_prefix(tmp_path):
     assert report["regressions"] == ["serve_p99_ms", "serve_throughput_rps"]
 
 
+def test_precision_rows_direction_pins(tmp_path):
+    """precision_* rows (benchmarks/precision_bench.py) are higher-better by
+    prefix pin — an agreement fraction that DROPS is the regression — and the
+    bf16/int8 throughput rows ride the existing anakin_/serve_ prefixes.
+    Precedence stays: exact-name pins > prefix pins > unit-text hints."""
+    assert bench_compare.lower_is_better("precision_parity_action_agreement", "fraction") is False
+    # "time"-ish unit text must NOT flip a precision_* row to lower-better
+    assert bench_compare.lower_is_better("precision_parity_kl", "nats at eval time") is False
+    assert bench_compare.lower_is_better("anakin_bf16_steps_per_sec", "env_steps/s") is False
+    assert bench_compare.lower_is_better("serve_int8_replies_per_sec", "replies/s") is False
+    # exact-name latency pins still beat every prefix
+    assert bench_compare.lower_is_better("serve_p99_ms", "ms") is True
+
+    base = _report(
+        tmp_path,
+        "BENCH_a.json",
+        {"precision_parity_action_agreement": (1.0, "fraction"), "serve_int8_replies_per_sec": (900.0, "replies/s")},
+    )
+    new = _report(
+        tmp_path,
+        "BENCH_b.json",
+        {"precision_parity_action_agreement": (0.80, "fraction"), "serve_int8_replies_per_sec": (950.0, "replies/s")},
+    )
+    report = bench_compare.compare(base, new, threshold=0.10)
+    assert report["regressions"] == ["precision_parity_action_agreement"]
+
+
 def test_no_dropped_metrics_strict_stays_green(tmp_path):
     base = _report(tmp_path, "BENCH_a.json", {"sps": (100.0, "grad_steps/s")})
     new = _report(tmp_path, "BENCH_b.json", {"sps": (102.0, "grad_steps/s"), "extra": (1.0, "x")})

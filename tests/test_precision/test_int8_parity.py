@@ -1,0 +1,129 @@
+"""int8-vs-f32 policy parity (howto/precision.md serving acceptance).
+
+Weights-only per-channel int8 quantization of the act-fn kernels must keep the
+served policy behaviourally indistinguishable: >= 99% greedy action agreement
+on seeded random observations, with the action-distribution drift bounded
+(categorical KL for PPO, mean drift for SAC's tanh-squashed Gaussian).
+"""
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.analysis.ir.synth import (
+    box_act_space,
+    compose_tiny,
+    discrete_act_space,
+    tiny_ctx,
+    vector_space,
+)
+from sheeprl_tpu.precision import (
+    Int8Weight,
+    categorical_kl,
+    dequantize_params,
+    gaussian_mean_divergence,
+)
+from sheeprl_tpu.utils.policy import build_policy, parity_stamp, wrap_policy_precision
+
+N_OBS = 512
+
+PPO_TINY = [
+    "exp=ppo",
+    "env=discrete_dummy",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.dense_units=32",
+    "algo.mlp_layers=1",
+    "algo.encoder.mlp_features_dim=32",
+    "mesh.precision=fp32",
+]
+SAC_TINY = [
+    "exp=sac",
+    "env=continuous_dummy",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.hidden_size=32",
+    "mesh.precision=fp32",
+]
+
+
+def _pair(overrides, act_space):
+    """(f32 policy, int8 twin of the same params) against explicit tiny spaces."""
+    cfg = compose_tiny(list(overrides))
+    policy, _ = build_policy(tiny_ctx(cfg), cfg, vector_space(), act_space, greedy=True)
+    cfg2 = compose_tiny(list(overrides))
+    quantized, _ = build_policy(tiny_ctx(cfg2), cfg2, vector_space(), act_space, greedy=True)
+    # identical seeds -> identical params; quantize one copy
+    quantized = wrap_policy_precision(quantized, "int8")
+    return policy, quantized
+
+
+def _random_obs(policy, n=N_OBS, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        k: rng.standard_normal((n, *shape)).astype(np.dtype(dtype))
+        for k, (shape, dtype) in policy.obs_template.items()
+    }
+
+
+def test_ppo_int8_greedy_agreement_and_bounded_kl():
+    policy, quantized = _pair(PPO_TINY, discrete_act_space())
+    stamp = parity_stamp(quantized, policy, n_obs=N_OBS, seed=0)
+    assert stamp["precision"] == "int8" and stamp["reference"] == "f32"
+    assert stamp["action_agreement"] >= 0.99, stamp
+
+    # distribution drift: per-head categorical KL on the raw logits
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+
+    cfg = compose_tiny(list(PPO_TINY))
+    agent, _ = build_agent(tiny_ctx(cfg), discrete_act_space(), vector_space(), cfg)
+    obs = _random_obs(policy)
+    logits_f32, _ = agent.apply(policy.params, obs)
+    logits_int8, _ = agent.apply(dequantize_params(quantized.params), obs)
+    for lp, lq in zip(logits_f32, logits_int8):
+        assert categorical_kl(lp, lq) <= 1e-3
+
+
+def test_sac_int8_greedy_agreement_and_bounded_mean_drift():
+    policy, quantized = _pair(SAC_TINY, box_act_space())
+    stamp = parity_stamp(quantized, policy, n_obs=N_OBS, seed=1)
+    assert stamp["action_agreement"] >= 0.99, stamp
+
+    obs = _random_obs(policy, seed=1)
+    key = np.zeros((2,), np.uint32)
+    a = jax.device_get(policy.act_fn(policy.params, obs, key))
+    b = jax.device_get(quantized.act_fn(quantized.params, obs, key))
+    assert gaussian_mean_divergence(a, b) <= 5e-3
+
+
+def test_int8_params_are_quantized_and_smaller():
+    policy, quantized = _pair(PPO_TINY, discrete_act_space())
+    kernels = [
+        leaf
+        for leaf in jax.tree.leaves(quantized.params, is_leaf=lambda x: isinstance(x, Int8Weight))
+        if isinstance(leaf, Int8Weight)
+    ]
+    assert kernels, "no kernel was quantized"
+    # every quantized kernel's int8 buffer is 4x smaller than its f32 source
+    for q in kernels:
+        assert q.q.dtype.itemsize == 1 and q.q.shape == q.shape
+    # dequantized params track the f32 originals within one quantization step
+    dq = dequantize_params(quantized.params)
+    for a, b in zip(jax.tree.leaves(policy.params), jax.tree.leaves(dq)):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)), atol=2e-2
+        )
+
+
+def test_bf16_wrap_casts_params_and_tracks_f32_actions():
+    cfg = compose_tiny(list(SAC_TINY))
+    policy, _ = build_policy(tiny_ctx(cfg), cfg, vector_space(), box_act_space(), greedy=True)
+    cfg2 = compose_tiny(list(SAC_TINY) + ["algo.precision=bf16"])
+    half, _ = build_policy(tiny_ctx(cfg2), cfg2, vector_space(), box_act_space(), greedy=True)
+    half = wrap_policy_precision(half, "bf16")
+    import jax.numpy as jnp
+
+    for leaf in jax.tree.leaves(half.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16
+    stamp = parity_stamp(half, policy, n_obs=N_OBS, seed=2)
+    assert stamp["precision"] == "bf16"
+    assert stamp["action_agreement"] >= 0.95, stamp

@@ -1,0 +1,174 @@
+"""sheeprl_tpu/precision: policy resolution, loss scaling, int8 quantization,
+parity helpers — the unit contracts under the bf16/int8 tier (howto/precision.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.analysis.ir.synth import compose_tiny
+from sheeprl_tpu.precision import (
+    DynamicLossScale,
+    Int8Weight,
+    NoOpLossScale,
+    action_agreement,
+    all_finite,
+    categorical_kl,
+    dequantize_params,
+    quantize_params,
+    quantize_weight,
+    resolve_policy,
+    train_policy,
+)
+
+
+# ------------------------------------------------------------ policy resolution
+@pytest.mark.parametrize(
+    "spec,param,compute",
+    [
+        ("f32", jnp.float32, jnp.float32),
+        ("fp32", jnp.float32, jnp.float32),
+        ("bf16", jnp.float32, jnp.bfloat16),
+        ("bf16-mixed", jnp.float32, jnp.bfloat16),
+        ("bf16-true", jnp.bfloat16, jnp.bfloat16),
+        ("fp16", jnp.float32, jnp.float16),
+    ],
+)
+def test_resolve_policy_dtype_triples(spec, param, compute):
+    policy = resolve_policy(spec)
+    assert policy.param_dtype == param
+    assert policy.compute_dtype == compute
+
+
+def test_resolve_policy_unknown_raises():
+    with pytest.raises(ValueError, match="nonsense"):
+        resolve_policy("nonsense")
+
+
+def test_train_policy_mesh_inherit_and_explicit_override():
+    cfg = compose_tiny(["exp=ppo", "env=discrete_dummy", "algo.mlp_keys.encoder=[state]"])
+    assert cfg.algo.precision == "mesh"
+    # mesh default is bf16-mixed -> inherited bf16 compute
+    assert train_policy(cfg).compute_dtype == jnp.bfloat16
+    cfg.mesh.precision = "fp32"
+    assert train_policy(cfg).compute_dtype == jnp.float32
+    # the algo knob overrides the mesh in BOTH directions
+    cfg.algo.precision = "bf16"
+    assert train_policy(cfg).compute_dtype == jnp.bfloat16
+    assert train_policy(cfg).param_dtype == jnp.float32
+    cfg.mesh.precision = "bf16-mixed"
+    cfg.algo.precision = "f32"
+    assert train_policy(cfg).compute_dtype == jnp.float32
+
+
+def test_train_policy_explicit_fp16_rejected():
+    cfg = compose_tiny(["exp=ppo", "env=discrete_dummy", "algo.mlp_keys.encoder=[state]"])
+    cfg.algo.precision = "fp16"
+    with pytest.raises(ValueError, match="bf16"):
+        train_policy(cfg)
+
+
+def test_cast_to_compute_touches_only_float_leaves():
+    policy = resolve_policy("bf16")
+    tree = {"w": jnp.ones((2, 2), jnp.float32), "step": jnp.zeros((), jnp.int32)}
+    out = policy.cast_to_compute(tree)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["step"].dtype == jnp.int32
+    back = policy.cast_to_output(out)
+    assert back["w"].dtype == jnp.float32
+
+
+# ------------------------------------------------------------------- loss scale
+def test_all_finite_flags_nan_and_inf():
+    assert bool(all_finite({"a": jnp.ones(3)}))
+    assert not bool(all_finite({"a": jnp.array([1.0, jnp.nan])}))
+    assert not bool(all_finite({"a": jnp.array([jnp.inf])}))
+
+
+def test_dynamic_loss_scale_halves_on_nonfinite_and_doubles_after_period():
+    scale = DynamicLossScale(scale=16.0, period=2)
+    # non-finite step: halve, reset counter
+    down = scale.adjust(jnp.asarray(False))
+    assert float(down.loss_scale) == 8.0 and int(down.counter) == 0
+    # `period` consecutive finite steps: double
+    up = scale
+    for _ in range(2):
+        up = up.adjust(jnp.asarray(True))
+    assert float(up.loss_scale) == 32.0
+    # floor: never below min_scale
+    floored = DynamicLossScale(scale=1.0, min_scale=1.0).adjust(jnp.asarray(False))
+    assert float(floored.loss_scale) == 1.0
+
+
+def test_loss_scale_is_a_pytree_and_jits():
+    scale = DynamicLossScale(scale=4.0)
+
+    @jax.jit
+    def step(s, ok):
+        return s.adjust(ok)
+
+    out = step(scale, jnp.asarray(True))
+    assert float(out.loss_scale) == 4.0 and int(out.counter) == 1
+    # scale/unscale round-trip through the no-op policy is the identity
+    noop = NoOpLossScale()
+    assert float(noop.scale(jnp.float32(3.0))) == 3.0
+    assert noop.adjust(jnp.asarray(False)) is noop
+
+
+# ------------------------------------------------------------------------ int8
+def test_int8_weight_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    q = quantize_weight(w)
+    assert q.q.dtype == jnp.int8 and q.scale.shape == (1, 32)
+    err = jnp.max(jnp.abs(q.dequantize() - w))
+    # symmetric per-channel: max error is half a quantization step = scale/2
+    assert float(err) <= float(jnp.max(q.scale)) * 0.51 + 1e-7
+
+
+def test_quantize_params_replaces_only_2d_float_kernels():
+    params = {
+        "dense": {"kernel": jnp.ones((4, 8)), "bias": jnp.ones((8,))},
+        "count": jnp.zeros((), jnp.int32),
+    }
+    q = quantize_params(params)
+    assert isinstance(q["dense"]["kernel"], Int8Weight)
+    assert q["dense"]["bias"].dtype == jnp.float32
+    assert q["count"].dtype == jnp.int32
+    d = dequantize_params(q)
+    assert d["dense"]["kernel"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(d["dense"]["kernel"]), 1.0, atol=1e-2)
+
+
+def test_int8_weight_passes_through_jit_and_dequant_fuses():
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(16, 8)).astype(np.float32))
+    q = quantize_weight(w)
+    x = jnp.ones((4, 16))
+
+    @jax.jit
+    def matmul(qw, x):
+        return x @ qw.dequantize()
+
+    out = matmul(q, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w), atol=0.2)
+
+
+# ---------------------------------------------------------------------- parity
+def test_action_agreement_discrete_and_continuous():
+    a = np.array([0, 1, 2, 3])
+    assert action_agreement(a, np.array([0, 1, 2, 0])) == 0.75
+    # multi-discrete: list of per-head actions, row agrees when ALL heads agree
+    assert action_agreement([a, a], [a, np.array([0, 1, 2, 0])]) == 0.75
+    c = np.zeros((4, 2), np.float32)
+    near = c + 5e-3
+    far = c + 5e-1
+    assert action_agreement(c, near, continuous=True) == 1.0
+    assert action_agreement(c, far, continuous=True) == 0.0
+
+
+def test_categorical_kl_zero_for_identical_logits():
+    logits = jnp.asarray(np.random.default_rng(2).normal(size=(8, 5)).astype(np.float32))
+    assert float(categorical_kl(logits, logits)) == pytest.approx(0.0, abs=1e-6)
+    shifted = logits + 1.0  # softmax-invariant shift
+    assert float(categorical_kl(logits, shifted)) == pytest.approx(0.0, abs=1e-5)
+    assert float(categorical_kl(logits, logits * 2.0)) > 0.0

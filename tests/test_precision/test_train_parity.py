@@ -1,0 +1,184 @@
+"""bf16-vs-f32 train-step parity (howto/precision.md).
+
+Same seeds, same synthetic envs, mesh pinned to fp32 so ``algo.precision`` is
+the ONLY difference: params init identically (param_dtype stays f32 under the
+mixed policy), one fused Anakin step runs per tier, and the bf16 losses must
+track f32 within the documented tolerance (|Δ| <= 0.05 absolute or 10%
+relative) while params and optimizer state stay f32 throughout.
+"""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.analysis.ir.synth import compose_tiny, tiny_ctx
+
+# Documented parity tolerance for one update on a random init (losses are O(1)).
+LOSS_RTOL = 0.10
+LOSS_ATOL = 0.05
+
+
+def _loss_keys(metrics):
+    return sorted(k for k in metrics if k.startswith("Loss/"))
+
+
+def _assert_losses_track(m_f32, m_bf16):
+    keys = _loss_keys(m_f32)
+    assert keys, "no Loss/* metrics to compare"
+    assert keys == _loss_keys(m_bf16)
+    for k in keys:
+        a = float(np.asarray(jax.device_get(m_f32[k])).mean())
+        b = float(np.asarray(jax.device_get(m_bf16[k])).mean())
+        assert abs(a - b) <= LOSS_ATOL + LOSS_RTOL * abs(a), f"{k}: f32={a} bf16={b}"
+
+
+def _assert_params_f32(params):
+    for leaf in jax.tree.leaves(params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32, "mixed policy must keep params f32"
+
+
+def _run_ppo_step(precision):
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import PPOTrainFns
+    from sheeprl_tpu.engine.anakin import (
+        anakin_env,
+        anakin_mlp_key,
+        init_episode_stats,
+        make_ppo_anakin_iteration,
+        reset_envs,
+    )
+
+    cfg = compose_tiny(
+        [
+            "exp=ppo",
+            "env=jax_cartpole",
+            "algo.anakin=True",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.rollout_steps=4",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.mlp_features_dim=8",
+            "env.num_envs=2",
+            "mesh.precision=fp32",
+            f"algo.precision={precision}",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    env, env_params = anakin_env(cfg)
+    obs_key = anakin_mlp_key(cfg)
+    obs_space = gym.spaces.Dict({obs_key: env.observation_space(env_params)})
+    agent, params = build_agent(ctx, env.action_space(env_params), obs_space, cfg)
+    fns = PPOTrainFns(ctx, agent, cfg, [obs_key], num_updates=4)
+    iteration = make_ppo_anakin_iteration(env, env_params, agent, fns, cfg, obs_key)
+    env_state, obs0 = reset_envs(env, env_params, 2, jax.random.PRNGKey(1))
+    carry = {
+        "params": params,
+        "opt_state": fns.opt.init(params),
+        "env_state": env_state,
+        "obs": obs0,
+        "key": jax.random.PRNGKey(0),
+        "episode_stats": init_episode_stats(2),
+    }
+    new_carry, metrics = jax.jit(iteration)(carry, 0.2, 0.0)
+    return jax.device_get(params), new_carry, metrics
+
+
+def _run_sac_dispatch(precision):
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.data.device_buffer import DeviceTransitionRing
+    from sheeprl_tpu.engine.anakin import (
+        anakin_env,
+        anakin_mlp_key,
+        init_episode_stats,
+        make_sac_anakin_dispatch,
+        reset_envs,
+    )
+
+    cfg = compose_tiny(
+        [
+            "exp=sac",
+            "env=jax_pendulum",
+            "algo.anakin=True",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.hidden_size=8",
+            "algo.per_rank_batch_size=4",
+            "algo.replay_ratio=1",
+            "env.num_envs=2",
+            "buffer.size=64",
+            "mesh.precision=fp32",
+            f"algo.precision={precision}",
+        ]
+    )
+    ctx = tiny_ctx(cfg)
+    env, env_params = anakin_env(cfg)
+    mlp_key = anakin_mlp_key(cfg)
+    obs_space_box = env.observation_space(env_params)
+    act_space = env.action_space(env_params)
+    obs_space = gym.spaces.Dict({mlp_key: obs_space_box})
+    actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
+    params = jax.tree.map(jnp.copy, params)
+    obs_dim = int(np.prod(obs_space_box.shape))
+    act_dim = int(np.prod(act_space.shape))
+    ring = DeviceTransitionRing(
+        32,
+        2,
+        {
+            "obs": ((obs_dim,), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "actions": ((act_dim,), jnp.float32),
+            "rewards": ((1,), jnp.float32),
+            "dones": ((1,), jnp.float32),
+        },
+    )
+    actor_opt, critic_opt, alpha_opt, builder = make_sac_anakin_dispatch(
+        env, env_params, actor, critic, cfg, act_space, ring, 4
+    )
+    env_state, obs0 = reset_envs(env, env_params, 2, jax.random.PRNGKey(1))
+    carry = {
+        "params": params,
+        "opt_state": {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        },
+        "env_state": env_state,
+        "obs": obs0,
+        "ring": ring.arrays,
+        "rows_added": jnp.zeros((), jnp.int32),
+        "gstep": jnp.zeros((), jnp.int32),
+        "key": jax.random.PRNGKey(0),
+        "episode_stats": init_episode_stats(2),
+    }
+    init_params = jax.device_get(params)
+    new_carry, metrics = jax.jit(builder(8, 1, True), donate_argnums=(0,))(carry)
+    return init_params, new_carry, metrics
+
+
+def test_ppo_bf16_step_tracks_f32_losses():
+    init_f32, carry_f32, m_f32 = _run_ppo_step("f32")
+    init_bf16, carry_bf16, m_bf16 = _run_ppo_step("bf16")
+    # identical init: param_dtype is f32 under BOTH tiers and seeds match
+    for a, b in zip(jax.tree.leaves(init_f32), jax.tree.leaves(init_bf16)):
+        np.testing.assert_array_equal(a, b)
+    _assert_losses_track(m_f32, m_bf16)
+    _assert_params_f32(carry_bf16["params"])
+    _assert_params_f32(carry_bf16["opt_state"])
+    # the updated params stay close between tiers after one step
+    for a, b in zip(jax.tree.leaves(carry_f32["params"]), jax.tree.leaves(carry_bf16["params"])):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)), atol=5e-2
+        )
+
+
+def test_sac_bf16_dispatch_tracks_f32_losses():
+    init_f32, carry_f32, m_f32 = _run_sac_dispatch("f32")
+    init_bf16, carry_bf16, m_bf16 = _run_sac_dispatch("bf16")
+    for a, b in zip(jax.tree.leaves(init_f32), jax.tree.leaves(init_bf16)):
+        np.testing.assert_array_equal(a, b)
+    _assert_losses_track(m_f32, m_bf16)
+    _assert_params_f32(carry_bf16["params"])
+    _assert_params_f32(carry_bf16["opt_state"])

@@ -61,7 +61,7 @@ def _zero_obs(obs_template):
     return {k: np.zeros(shape, dtype=np.dtype(dtype)) for k, (shape, dtype) in obs_template.items()}
 
 
-def _start_server(registry_dir, policies, max_batch=4, delay_ms=2.0):
+def _start_server(registry_dir, policies, max_batch=4, delay_ms=2.0, extra=()):
     """Compose serve_cli, build the server (precompiles the ladder), run it in a
     thread; returns ``(server, thread, rc_box)`` once the listener is up."""
     from sheeprl_tpu.config.core import compose
@@ -78,6 +78,7 @@ def _start_server(registry_dir, policies, max_batch=4, delay_ms=2.0):
             f"serve.max_batch_delay_ms={delay_ms}",
             "serve.log_every_s=0",
             "analysis.strict=True",
+            *extra,
         ],
     )
     server = PolicyServer(cfg)
@@ -161,6 +162,55 @@ def test_multi_policy_routing_and_unknown_policy(registry):
     per_policy = server.summary()["policies"]
     assert per_policy[f"{MODEL}:2"]["accepted"] == per_policy[f"{MODEL}:2"]["replied"] == 3
     assert per_policy[f"{MODEL}:1"]["accepted"] == per_policy[f"{MODEL}:1"]["replied"] == 2
+
+
+def test_int8_serving_parity_stamp_and_zero_recompiles(registry):
+    """serve.precision=int8: the ladder compiles against the quantized params,
+    the parity stamp (vs an f32 reference reload) lands in pong/summary with
+    high greedy agreement, and dispatches stay recompile-free under strict."""
+    registry_dir, obs_template = registry
+    server, thread, rc_box = _start_server(
+        registry_dir, [f"{MODEL}:1"], extra=["serve.precision=int8"]
+    )
+    obs = _zero_obs(obs_template)
+    try:
+        import jax
+
+        from sheeprl_tpu.precision import Int8Weight
+
+        assert server.precision == "int8"
+        ep = server.endpoints[f"{MODEL}:1"]
+        assert ep.policy.precision == "int8"
+        kernels = [
+            leaf
+            for leaf in jax.tree.leaves(
+                ep.policy.params, is_leaf=lambda x: isinstance(x, Int8Weight)
+            )
+            if isinstance(leaf, Int8Weight)
+        ]
+        assert kernels, "no 2-D kernel was quantized"
+
+        stamp = server.parity[f"{MODEL}:1"]
+        assert stamp["precision"] == "int8" and stamp["reference"] == "f32"
+        assert stamp["action_agreement"] >= 0.99
+
+        with PolicyClient("127.0.0.1", server.listener.port) as client:
+            pong = client.ping()
+            assert pong["precision"] == "int8"
+            assert pong["parity"][f"{MODEL}:1"]["action_agreement"] >= 0.99
+            for _ in range(5):
+                action, meta = client.act(obs, MODEL)
+                assert meta["bucket"] in ep.ladder
+    finally:
+        server.shutdown()
+        thread.join(timeout=30)
+
+    assert rc_box.get("rc") == 0
+    summary = server.summary()
+    assert summary["precision"] == "int8"
+    assert summary["parity"][f"{MODEL}:1"]["action_agreement"] >= 0.99
+    assert summary["accepted"] == summary["replied"] == 5
+    assert summary["recompiles"] == 0
 
 
 def test_preemption_drains_and_replies_to_everything_accepted(registry):
