@@ -48,6 +48,7 @@ _HIGHER_BETTER_PREFIXES = ("anakin_", "sebulba_", "serve_", "precision_")
 _LOWER_BETTER_METRICS = (
     "anakin_compile_seconds",
     "checkpoint_save_seconds",
+    "obs_fleet_overhead_pct",
     "resume_restore_seconds",
     "serve_p99_ms",
     "serve_startup_seconds",

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Fleet-exporter overhead: what does per-step telemetry cost the hot loop?
+
+The fleet plane's contract (``sheeprl_tpu/obs/fleet.py``) is that per-step
+bookkeeping is two dict writes under a lock — the framed TCP send happens on the
+exporter's daemon thread at ``obs.fleet.interval_s`` cadence, never on the step
+path.  This bench A/Bs a simulated training step loop (a calibrated ~2 ms
+busy-spin standing in for a jitted update at small-model CPU scale — the WORST
+case for relative overhead; real TPU steps are longer) with and without a live
+exporter wired to a real in-process :class:`FleetAggregator` over loopback TCP:
+
+    overhead_pct = (wall_with_exporter - wall_bare) / wall_bare * 100
+
+Emits one BENCH-style JSON row, ``obs_fleet_overhead_pct`` — direction-pinned
+lower-better by exact name in ``benchmarks/bench_compare.py``, acceptance
+ceiling 2% (also asserted in ``tests/test_obs/test_fleet.py``).  Runs as part of
+``benchmarks/sebulba_bench.py`` unless ``BENCH_OBS=0``.
+
+Usage::
+
+    python benchmarks/obs_overhead_bench.py [--steps 400] [--step-ms 2.0] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _step(work_s: float) -> int:
+    """Deterministic busy-spin: the stand-in for one jitted training step."""
+    deadline = time.perf_counter() + work_s
+    spins = 0
+    while time.perf_counter() < deadline:
+        spins += 1
+    return spins
+
+
+def _measure(steps: int, work_s: float, exporter=None) -> float:
+    t0 = time.perf_counter()
+    for i in range(steps):
+        _step(work_s)
+        if exporter is not None:
+            # Exactly what the learner loop records per consumed block.
+            exporter.counter("grad_steps", i)
+            exporter.counter("env_steps", i * 64)
+            exporter.gauge("Sebulba/queue_depth", i % 7)
+            exporter.gauge("Sebulba/param_staleness_steps", i % 3)
+    return time.perf_counter() - t0
+
+
+def run_bench(steps: int = 400, step_ms: float = 2.0, repeats: int = 3) -> dict:
+    from sheeprl_tpu.distributed.transport import connect
+    from sheeprl_tpu.obs.fleet import FleetAggregator, FleetExporter
+
+    work_s = step_ms / 1000.0
+    tmp = tempfile.mkdtemp(prefix="obs_overhead_bench_")
+    agg = FleetAggregator(tmp)
+    host, port = agg.address.rsplit(":", 1)
+    tags = {
+        "role": "learner",
+        "actor_id": 0,
+        "generation": 0,
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "trace_id": "bench",
+    }
+    exporter = FleetExporter(tags, channel=connect(host, int(port), timeout_s=5.0), interval_s=0.25)
+    try:
+        bare: List[float] = []
+        with_exp: List[float] = []
+        _measure(steps // 4, work_s)  # warmup: timer + allocator settle
+        for _ in range(repeats):  # interleave so drift hits both arms equally
+            bare.append(_measure(steps, work_s))
+            with_exp.append(_measure(steps, work_s, exporter))
+        overhead = (min(with_exp) - min(bare)) / min(bare) * 100.0
+    finally:
+        exporter.close()
+        agg.close()
+    return {
+        "metric": "obs_fleet_overhead_pct",
+        "value": round(max(overhead, 0.0), 3),
+        "unit": (
+            f"% step-time overhead (lower is better; {steps} x {step_ms}ms simulated "
+            f"steps, best-of-{repeats}, live aggregator over loopback)"
+        ),
+        "rows_exported": agg.rows_written,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--steps", type=int, default=int(os.environ.get("BENCH_OBS_STEPS", "400")))
+    parser.add_argument(
+        "--step-ms", type=float, default=float(os.environ.get("BENCH_OBS_STEP_MS", "2.0"))
+    )
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args(argv)
+    print(json.dumps(run_bench(steps=args.steps, step_ms=args.step_ms, repeats=args.repeats)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

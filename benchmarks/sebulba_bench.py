@@ -179,6 +179,13 @@ def main(argv: Optional[List[str]] = None) -> None:
         "speedup_vs_thread_decoupled": round(two_sps / thread_sps, 3) if thread_sps > 0 else None,
     }))
 
+    # Fleet-exporter overhead rides along (BENCH_OBS=0 skips it): the telemetry
+    # plane's ≤2% step-time budget, measured against a live loopback aggregator.
+    if os.environ.get("BENCH_OBS", "1") != "0":
+        from obs_overhead_bench import run_bench as _obs_run_bench
+
+        print(json.dumps(_obs_run_bench()))
+
 
 if __name__ == "__main__":
     main()

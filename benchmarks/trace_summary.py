@@ -1,10 +1,12 @@
 #!/usr/bin/env python
-"""Fold a Chrome-trace JSON (exported by ``sheeprl_tpu.obs``) OR a flight-recorder
-blackbox event log into a per-phase table.
+"""Fold a Chrome-trace JSON (exported by ``sheeprl_tpu.obs``), a flight-recorder
+blackbox event log, OR a fleet timeline into a per-phase / per-role table.
 
 Usage:
     python benchmarks/trace_summary.py <log_dir>/trace.json [--json]
     python benchmarks/trace_summary.py <log_dir>/blackbox/events.jsonl [--json]
+    python benchmarks/trace_summary.py <run_dir>/fleet/timeline.jsonl [--json]
+    python benchmarks/trace_summary.py <run_dir>/fleet/trace_fleet.json [--json]
 
 Per span name: call count, total time, share of the top-level (depth-0) wall clock, and
 p50/p95/p99 latencies.  ``--json`` emits the same table as a JSON object for BENCH
@@ -14,6 +16,15 @@ Blackbox event JSONL (one JSON object per line, ``obs/flight_recorder.py``) is
 detected automatically: ``span`` events feed the same per-phase table (depth from
 the recorder), every other event kind is summarized by count — so one tool reads
 both live traces and post-mortem dumps.
+
+Fleet inputs (``sheeprl_tpu/obs/fleet.py``) are detected automatically too: a
+timeline JSONL (rows tagged ``{role, actor_id, generation, ...}`` with a
+``metrics`` dict) folds into one row per process slot — last throughput rates,
+queue depth / staleness gauges, and the publish→apply weight-propagation latency
+(``Sebulba/publish_apply_ms``) correlated across roles by the shared trace id.
+A *merged* multi-process Chrome trace (``trace_fleet.json``) groups phases per
+process using its ``process_name`` metadata; single-process traces render
+exactly as before.
 """
 
 from __future__ import annotations
@@ -39,6 +50,138 @@ def _load_blackbox_events(path: str) -> List[Dict[str, Any]]:
             if isinstance(event, dict) and "kind" in event:
                 events.append(event)
     return events
+
+
+def _first_json_line(path: str) -> Any:
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    return json.loads(line)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return None
+
+
+def _is_fleet_timeline(path: str) -> bool:
+    """Fleet timeline rows carry the tag schema + a metrics dict (no "kind"), so
+    this check must run BEFORE the blackbox sniff — both are JSONL."""
+    if not path.endswith((".jsonl", ".json")):
+        return False
+    first = _first_json_line(path)
+    return isinstance(first, dict) and "role" in first and "metrics" in first
+
+
+def summarize_fleet(path: str) -> Dict[str, Any]:
+    """Fleet timeline -> one row per process slot (``role`` + ``actor_id``).
+
+    Counters were already folded into ``<name>_per_s`` rates by the aggregator;
+    this keeps each slot's *peak* rates (every exporter's close-time flush drives
+    the last-row rate to ~0, so "last" would always read as drained) and last
+    gauges, plus the mean publish→apply latency — the cross-process
+    weight-propagation figure the correlated trace ids make meaningful."""
+    slots: Dict[str, Dict[str, Any]] = {}
+    trace_id = None
+    walls: List[float] = []
+    n_rows = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(row, dict) or "role" not in row:
+                continue
+            n_rows += 1
+            trace_id = row.get("trace_id") or trace_id
+            wall = row.get("wall_clock")
+            if isinstance(wall, (int, float)):
+                walls.append(float(wall))
+            key = f"{row.get('role')}{row.get('actor_id', 0)}"
+            slot = slots.setdefault(
+                key,
+                {
+                    "role": row.get("role"),
+                    "actor_id": row.get("actor_id", 0),
+                    "rows": 0,
+                    "generations": set(),
+                    "pids": set(),
+                    "publish_apply_ms": [],
+                    "last": {},
+                },
+            )
+            slot["rows"] += 1
+            slot["generations"].add(row.get("generation", 0))
+            if row.get("pid") is not None:
+                slot["pids"].add(row["pid"])
+            metrics = row.get("metrics") or {}
+            apply_ms = metrics.get("Sebulba/publish_apply_ms")
+            if isinstance(apply_ms, (int, float)):
+                slot["publish_apply_ms"].append(float(apply_ms))
+            peaks = slot.setdefault("peak_rates", {})
+            for name, value in metrics.items():
+                if name.endswith("_per_s") and isinstance(value, (int, float)):
+                    peaks[name] = max(peaks.get(name, 0.0), float(value))
+            slot["last"] = metrics
+    for slot in slots.values():
+        slot["generations"] = sorted(slot["generations"])
+        slot["pids"] = sorted(slot["pids"])
+        samples = slot.pop("publish_apply_ms")
+        slot["publish_apply_ms_mean"] = sum(samples) / len(samples) if samples else None
+        last = slot.pop("last")
+        slot["rates"] = slot.pop("peak_rates", {})
+        slot["gauges"] = {
+            k: v for k, v in last.items() if not k.endswith("_per_s") and "/" in k
+        }
+    order = {"learner": 0, "actor": 1, "serve": 2}
+    return {
+        "timeline": path,
+        "trace_id": trace_id,
+        "rows": n_rows,
+        "window_s": (max(walls) - min(walls)) if len(walls) > 1 else 0.0,
+        "slots": dict(
+            sorted(slots.items(), key=lambda kv: (order.get(str(kv[1]["role"]), 9), kv[0]))
+        ),
+    }
+
+
+def format_fleet_table(summary: Dict[str, Any]) -> str:
+    headers = ("slot", "role", "rows", "gens", "grad/s", "env/s", "pub->apply_ms", "gauges")
+    rows = []
+    for key, slot in summary["slots"].items():
+        rates = slot["rates"]
+        apply_ms = slot["publish_apply_ms_mean"]
+        gauges = ", ".join(
+            f"{name.split('/', 1)[1]}={value:.3g}" for name, value in sorted(slot["gauges"].items())
+        )
+        rows.append(
+            (
+                key,
+                str(slot["role"]),
+                str(slot["rows"]),
+                ",".join(str(g) for g in slot["generations"]),
+                f"{rates['grad_steps_per_s']:.2f}" if "grad_steps_per_s" in rates else "-",
+                f"{rates['env_steps_per_s']:.2f}" if "env_steps_per_s" in rates else "-",
+                f"{apply_ms:.2f}" if apply_ms is not None else "-",
+                gauges or "-",
+            )
+        )
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h) for i, h in enumerate(headers)]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+        "-" * (sum(widths) + 2 * (len(widths) - 1)),
+    ]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    lines.append(
+        f"fleet: {summary['rows']} rows over {summary['window_s']:.1f} s"
+        + (f" (trace_id={summary['trace_id']})" if summary.get("trace_id") else "")
+    )
+    return "\n".join(lines)
 
 
 def _is_blackbox_log(path: str) -> bool:
@@ -80,16 +223,30 @@ def summarize_blackbox(path: str) -> Dict[str, Any]:
 
 
 def summarize(path: str) -> Dict[str, Any]:
+    if _is_fleet_timeline(path):
+        return summarize_fleet(path)
     if _is_blackbox_log(path):
         return summarize_blackbox(path)
     with open(path) as f:
         doc = json.load(f)
     events = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    # A merged fleet trace spans several processes: group phases per process
+    # using the process_name metadata.  Single-process traces (the common case,
+    # and what the tests pin) keep their bare phase names.
+    labels = {
+        e.get("pid"): str((e.get("args") or {}).get("name", e.get("pid")))
+        for e in doc.get("traceEvents", [])
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    multi = len({e.get("pid") for e in events}) > 1
     phases: Dict[str, List[float]] = {}
     top_level_total = 0.0
     for e in events:
         dur_ms = float(e.get("dur", 0.0)) / 1e3
-        phases.setdefault(e["name"], []).append(dur_ms)
+        name = e["name"]
+        if multi:
+            name = f"[{labels.get(e.get('pid'), e.get('pid'))}] {name}"
+        phases.setdefault(name, []).append(dur_ms)
         if e.get("args", {}).get("depth", 0) == 0:
             top_level_total += dur_ms
     return _phase_rows(path, phases, top_level_total)
@@ -164,6 +321,8 @@ def main(argv=None) -> int:
     summary = summarize(args.trace)
     if args.json:
         print(json.dumps(summary, indent=2))
+    elif "slots" in summary:
+        print(format_fleet_table(summary))
     else:
         print(format_table(summary))
     return 0

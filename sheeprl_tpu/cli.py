@@ -210,6 +210,7 @@ def run_algorithm(cfg: DotDict) -> None:
     # the entry point's TrainingMonitor and cleared here so back-to-back runs in
     # one process never cross-contaminate.
     from sheeprl_tpu.obs import flight_recorder
+    from sheeprl_tpu.obs import fleet as obs_fleet
 
     try:
         entry["entrypoint"](ctx, cfg, **kwargs)
@@ -221,9 +222,13 @@ def run_algorithm(cfg: DotDict) -> None:
         dump = flight_recorder.dump_active("crash", exc)
         if dump:
             print(f"flight recorder: black box dumped to {dump}", file=sys.stderr)
+        # A crashing process with a private in-process aggregator (obs.fleet.dir
+        # mode) flags the crash in its final snapshot before the plane goes down.
+        obs_fleet.close_active(error=exc)
         raise
     finally:
         flight_recorder.install(None)
+        obs_fleet.close_active()
 
 
 def eval_algorithm(cfg: DotDict) -> None:

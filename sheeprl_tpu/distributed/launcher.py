@@ -45,7 +45,13 @@ from sheeprl_tpu.distributed.placement import (
     placement_from_cfg,
 )
 from sheeprl_tpu.distributed.transport import Listener, connect
-from sheeprl_tpu.fault.supervisor import _strip_override, backoff_seconds
+from sheeprl_tpu.fault.supervisor import _strip_override, backoff_seconds, run_dir_for
+from sheeprl_tpu.obs.fleet import (
+    FLEET_ENV_VAR,
+    TRACE_ID_ENV_VAR,
+    FleetAggregator,
+    new_trace_id,
+)
 
 
 def _log(msg: str) -> None:
@@ -109,6 +115,26 @@ def launch(args: Optional[List[str]] = None) -> int:
         port = probe.port
         probe.close()
 
+    # Fleet telemetry plane: the launcher hosts the aggregator (the only process
+    # that outlives every role) and hands children its address + the run-level
+    # trace id through the environment.  run_dir_for needs no JAX — the fleet
+    # dir lands next to the learner's versioned log dirs.
+    fleet: Optional[FleetAggregator] = None
+    trace_id = os.environ.get(TRACE_ID_ENV_VAR) or new_trace_id()
+    fleet_cfg = dict((cfg.get("obs") or {}).get("fleet") or {})
+    if bool(fleet_cfg.get("enabled", True)):
+        fleet_dir = str(fleet_cfg.get("dir") or run_dir_for(cfg) / "fleet")
+        try:
+            fleet = FleetAggregator(
+                fleet_dir,
+                host=spec.host,
+                liveness_timeout_s=float(fleet_cfg.get("liveness_timeout_s", 10.0)),
+                trace_id=trace_id,
+            )
+            _log(f"fleet telemetry at {fleet.address} -> {fleet_dir} (trace_id={trace_id})")
+        except OSError as e:
+            _log(f"fleet telemetry disabled: {e}")
+
     def child_env(role: str, generation: int = 0) -> Dict[str, str]:
         env = dict(os.environ)
         # The summary sink is learner-only; role/ids travel as overrides.
@@ -116,6 +142,10 @@ def launch(args: Optional[List[str]] = None) -> int:
         if role == ROLE_LEARNER and os.environ.get(SUMMARY_ENV_VAR):
             env[SUMMARY_ENV_VAR] = os.environ[SUMMARY_ENV_VAR]
         env[GENERATION_ENV_VAR] = str(generation)
+        env[TRACE_ID_ENV_VAR] = trace_id
+        env.pop(FLEET_ENV_VAR, None)
+        if fleet is not None:
+            env[FLEET_ENV_VAR] = fleet.address
         return env
 
     learner = _spawn(
@@ -154,11 +184,26 @@ def launch(args: Optional[List[str]] = None) -> int:
         except ValueError:  # not on the main thread (tests)
             pass
 
+    def collect_fleet_blackboxes(reason: str) -> None:
+        """Fleet blackbox: a child died — ask every survivor to dump its flight-
+        recorder ring into one correlated crash bundle (plus any on-disk
+        ``blackbox/`` dumps, the dead child's own crash dump among them)."""
+        if fleet is None or terminating["flag"]:
+            return
+        try:
+            bundle = fleet.collect_blackboxes(reason)
+            if bundle:
+                _log(f"fleet blackbox bundle: {bundle}")
+        except Exception as e:  # forensics must never take down the topology
+            _log(f"fleet blackbox collection failed: {e}")
+
     try:
         while True:
             rc = learner.poll()
             if rc is not None:
                 _log(f"learner exited rc={rc}")
+                if rc != 0:
+                    collect_fleet_blackboxes(f"learner_rc{rc}")
                 return rc
             now = time.monotonic()
             for i, proc in list(actors.items()):
@@ -168,10 +213,13 @@ def launch(args: Optional[List[str]] = None) -> int:
                     if arc == 0:
                         _log(f"actor{i} done")
                         continue
+                    collect_fleet_blackboxes(f"actor{i}_rc{arc}")
                     if terminating["flag"] or not spec.respawn:
                         _log(f"actor{i} died rc={arc}; not respawning")
                         continue
                     respawns[i] += 1
+                    if fleet is not None:
+                        fleet.note_respawn(i, respawns[i])
                     if respawns[i] > spec.max_actor_respawns:
                         _log(
                             f"actor{i} died rc={arc}; respawn budget "
@@ -206,6 +254,11 @@ def launch(args: Optional[List[str]] = None) -> int:
                 p.wait(timeout=max(deadline - time.monotonic(), 0.1))
             except subprocess.TimeoutExpired:
                 p.kill()
+        if fleet is not None:
+            # After the children exited: their exporters' close-time flushes and
+            # trace shipments are in, so the merged Perfetto file and the final
+            # snapshot cover every process.
+            fleet.close()
         for sig, handler in old_handlers.items():
             signal.signal(sig, handler)
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 from sheeprl_tpu.distributed.transport import Channel, ChannelClosed
@@ -110,9 +111,13 @@ class ChannelWeightPublisher:
             stamp = make_stamp(self.seq, grad_step, policy_step)
             host_params = jax.device_get(params)  # THE one host round-trip
             self._last = (host_params, stamp)
+            # t_pub rides transport meta, NOT the stamp: the stamp's
+            # {seq, grad_step, policy_step} shape is a pinned contract, while
+            # t_pub is fleet-telemetry lineage (publish→apply latency) that the
+            # consumer folds into its local copy of the stamp.
             for ch in list(self._channels()):
                 try:
-                    self.bytes_published += ch.send(PARAMS_KIND, host_params, stamp=stamp)
+                    self.bytes_published += ch.send(PARAMS_KIND, host_params, stamp=stamp, t_pub=time.time())
                 except ChannelClosed:
                     pass  # dead actor: its respawn gets a welcome publish instead
         return stamp
@@ -127,6 +132,6 @@ class ChannelWeightPublisher:
                 return
             host_params, stamp = self._last
             try:
-                self.bytes_published += ch.send(PARAMS_KIND, host_params, stamp=stamp)
+                self.bytes_published += ch.send(PARAMS_KIND, host_params, stamp=stamp, t_pub=time.time())
             except ChannelClosed:
                 pass

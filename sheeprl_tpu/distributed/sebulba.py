@@ -55,6 +55,9 @@ from sheeprl_tpu.distributed.transport import (
     connect,
     maybe_digest,
 )
+from sheeprl_tpu.obs import flight_recorder as _flight_recorder
+from sheeprl_tpu.obs import tracer as _tracer
+from sheeprl_tpu.obs.fleet import maybe_exporter
 from sheeprl_tpu.rollout.sharding import shard_pool_cfg
 
 HELLO_KIND = "hello"
@@ -205,13 +208,22 @@ class _StatsCollector:
         return pairs
 
 
+def _stamp_of(meta: Dict[str, Any]) -> Dict[str, Any]:
+    """Consumer-side stamp: the pinned ``{seq, grad_step, policy_step}`` plus the
+    publisher's ``t_pub`` lineage timestamp riding separately in transport meta."""
+    stamp = dict(meta.get("stamp") or {})
+    if meta.get("t_pub") is not None:
+        stamp["t_pub"] = float(meta["t_pub"])
+    return stamp
+
+
 def _pickup_params(ch: Channel, latest: Optional[Tuple[Any, Dict[str, Any]]]):
     """Drain every pending publish, keep only the freshest (actors may skip
     publishes, never act on older-than-latest params)."""
     while ch.poll(0):
         kind, meta, payload = ch.recv()
         if kind == PARAMS_KIND:
-            latest = (payload, dict(meta.get("stamp") or {}))
+            latest = (payload, _stamp_of(meta))
     return latest
 
 
@@ -227,11 +239,17 @@ def _await_params(ch: Channel, last_seq: int, timeout_s: float):
             raise TimeoutError(f"no param publish newer than seq={last_seq} within {timeout_s}s")
         kind, meta, payload = ch.recv(timeout=remaining)
         if kind == PARAMS_KIND:
-            latest = (payload, dict(meta.get("stamp") or {}))
+            latest = (payload, _stamp_of(meta))
     return _pickup_params(ch, latest)
 
 
+#: Set once any summary (success or error) reached disk in this process, so the
+#: setup-crash fallback in :func:`run` never clobbers the loop's richer one.
+_summary_written = False
+
+
 def _write_summary(summary: Dict[str, Any]) -> None:
+    global _summary_written
     path = os.environ.get(SUMMARY_ENV_VAR)
     if not path:
         return
@@ -239,6 +257,72 @@ def _write_summary(summary: Dict[str, Any]) -> None:
     with open(tmp, "w") as f:
         json.dump(summary, f)
     os.replace(tmp, path)
+    _summary_written = True
+
+
+def _exc_summary(exc: BaseException) -> Dict[str, Any]:
+    return {"type": type(exc).__name__, "message": str(exc)[:2000]}
+
+
+def _actor_observability(cfg, spec: PlacementSpec, log_dir: str, algo: str):
+    """Arm the actor-side observability stack (actors historically ran dark —
+    only the learner had a TrainingMonitor): a flight recorder whose ring the
+    fleet blackbox collects from survivors, a span tracer when ``obs.enabled``
+    turns tracing on (the exporter ships its events at close, so this process
+    gets a track in the merged Perfetto timeline), and the fleet exporter
+    itself.  Returns ``(exporter, tracer)``; both may be ``None``."""
+    obs_cfg = dict(cfg.get("obs") or {})
+    if bool(obs_cfg.get("flight_recorder", True)) and _flight_recorder.get_active() is None:
+        _flight_recorder.install(
+            _flight_recorder.FlightRecorder(
+                log_dir=log_dir,
+                capacity=int(obs_cfg.get("flight_recorder_capacity", 4096)),
+                keep_events=int(obs_cfg.get("flight_recorder_keep_events", 512)),
+                algo=f"{algo}_sebulba_actor",
+                cfg=cfg,
+            )
+        )
+    tracer = None
+    if bool(obs_cfg.get("enabled", False)) and bool(obs_cfg.get("trace", True)):
+        tracer = _tracer.SpanTracer(rank=0, max_events=int(obs_cfg.get("max_events", 100_000)))
+        _tracer.set_active(tracer)
+    exporter = maybe_exporter(
+        cfg, "actor", actor_id=spec.actor_id, generation=spec.generation, log_dir=log_dir
+    )
+    return exporter, tracer
+
+
+def _actor_obs_teardown(exporter, tracer) -> None:
+    """Ship the trace (exporter close does it while the tracer is still active),
+    then restore tracer state.  Never raises — actor teardown already has
+    channel/env cleanup to finish."""
+    try:
+        if exporter is not None:
+            exporter.close()
+    except Exception:
+        pass
+    if tracer is not None and _tracer.get_active() is tracer:
+        _tracer.set_active(None)
+
+
+def _note_param_apply(exporter, stamp: Dict[str, Any], policy_step: int) -> None:
+    """Staleness lineage: the consumer folds the publisher's transport-meta
+    ``t_pub`` into publish→apply latency, making a publish traceable from
+    learner emit to actor apply (the flight-recorder event joins the two rings
+    in a fleet blackbox bundle)."""
+    apply_ms = None
+    if stamp.get("t_pub") is not None:
+        apply_ms = max((time.time() - float(stamp["t_pub"])) * 1000.0, 0.0)
+    _flight_recorder.record_event(
+        "param_apply", seq=stamp.get("seq"), grad_step=stamp.get("grad_step"), apply_ms=apply_ms
+    )
+    if exporter is None:
+        return
+    exporter.gauge("Sebulba/publish_seq_applied", stamp.get("seq"))
+    exporter.gauge("Sebulba/publish_apply_ms", apply_ms)
+    staleness = staleness_steps(stamp, policy_step)
+    if staleness is not None:
+        exporter.gauge("Sebulba/param_staleness_steps", staleness)
 
 
 class _SlotAccounting:
@@ -277,6 +361,7 @@ def _run_sac_actor(ctx, cfg, spec: PlacementSpec) -> None:
 
     actor_id = spec.actor_id
     log_dir = get_log_dir(cfg)
+    fleet_exporter, actor_tracer = _actor_observability(cfg, spec, log_dir, "sac")
     shard_pool_cfg(cfg, spec.num_actors, actor_id)
     envs = make_vector_env(cfg, cfg.seed, actor_id, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -336,6 +421,7 @@ def _run_sac_actor(ctx, cfg, spec: PlacementSpec) -> None:
             if picked is not latest and picked is not None:
                 latest = picked
                 local_actor_params, stamp = jax.device_put(picked[0]["actor"]), picked[1]
+                _note_param_apply(fleet_exporter, stamp, policy_step)
             env_t0 = time.perf_counter()
             if iter_num <= learning_starts:
                 actions = np.stack([act_space.sample() for _ in range(num_envs)])
@@ -351,7 +437,8 @@ def _run_sac_actor(ctx, cfg, spec: PlacementSpec) -> None:
                     if rescale
                     else tanh_actions
                 )
-            next_obs, reward, terminated, truncated, info = envs.step(actions)
+            with _tracer.span("Time/env_interaction"):
+                next_obs, reward, terminated, truncated, info = envs.step(actions)
             done = np.logical_or(terminated, truncated)
 
             real_next = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
@@ -393,20 +480,27 @@ def _run_sac_actor(ctx, cfg, spec: PlacementSpec) -> None:
                         "rewards": sample["rewards"].reshape(grad_steps, batch_size, 1),
                         "dones": sample["dones"].reshape(grad_steps, batch_size, 1),
                     }
-            ch.send(
-                BLOCK_KIND,
-                {"batches": batches},
-                iter_num=iter_num,
-                grad_steps=grad_steps,
-                policy_step=policy_step,
-                env_time=env_time,
-                env_steps=iter_num * num_envs,
-                staleness=staleness_steps(stamp, policy_step),
-                stats=stats.drain(),
-            )
+            with _tracer.span("Time/block_send"):
+                ch.send(
+                    BLOCK_KIND,
+                    {"batches": batches},
+                    iter_num=iter_num,
+                    grad_steps=grad_steps,
+                    policy_step=policy_step,
+                    env_time=env_time,
+                    env_steps=iter_num * num_envs,
+                    staleness=staleness_steps(stamp, policy_step),
+                    stats=stats.drain(),
+                )
+            if fleet_exporter is not None:
+                fleet_exporter.counter("env_steps", iter_num * num_envs)
+                fleet_exporter.counter("blocks", iter_num)
+                fleet_exporter.counter("bytes_sent", ch.bytes_sent)
+                fleet_exporter.gauge("policy_step", policy_step)
         ch.send(DONE_KIND, None, env_steps=num_iters * num_envs)
         ch.drain_until_closed(spec.connect_timeout_s)
     finally:
+        _actor_obs_teardown(fleet_exporter, actor_tracer)
         ch.close()
         envs.close()
 
@@ -431,6 +525,7 @@ def _run_sac_learner(ctx, cfg, spec: PlacementSpec) -> None:
     save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
     monitor = TrainingMonitor(cfg, log_dir)
+    fleet_exporter = maybe_exporter(cfg, "learner", generation=spec.generation, log_dir=log_dir)
 
     obs_space, act_space = _probe_spaces(cfg)
     actor_net, critic, params = build_agent(ctx, act_space, obs_space, cfg)
@@ -506,6 +601,7 @@ def _run_sac_learner(ctx, cfg, spec: PlacementSpec) -> None:
         publish=publish,
         save_state=save_state,
         sps_env_steps=cfg.env.num_envs,
+        fleet_exporter=fleet_exporter,
     )
 
 
@@ -524,6 +620,7 @@ def _run_ppo_actor(ctx, cfg, spec: PlacementSpec) -> None:
 
     actor_id = spec.actor_id
     log_dir = get_log_dir(cfg)
+    fleet_exporter, actor_tracer = _actor_observability(cfg, spec, log_dir, "ppo")
     shard_pool_cfg(cfg, spec.num_actors, actor_id)
     envs = make_vector_env(cfg, cfg.seed, actor_id, log_dir if cfg.env.capture_video else None)
     obs_space = envs.single_observation_space
@@ -586,7 +683,8 @@ def _run_ppo_actor(ctx, cfg, spec: PlacementSpec) -> None:
                     env_actions = env_act_np[..., 0]
                 else:
                     env_actions = env_act_np
-                next_obs, reward, terminated, truncated, info = envs.step(env_actions)
+                with _tracer.span("Time/env_interaction"):
+                    next_obs, reward, terminated, truncated, info = envs.step(env_actions)
                 if cfg.env.clip_rewards:
                     reward = np.clip(reward, -1, 1)
                 done = np.logical_or(terminated, truncated)
@@ -629,25 +727,34 @@ def _run_ppo_actor(ctx, cfg, spec: PlacementSpec) -> None:
                 "advantages": advantages[..., 0],
             }
             data = jax.tree.map(lambda x: np.asarray(x).reshape(batch_n, *x.shape[2:]), data)
-            ch.send(
-                BLOCK_KIND,
-                {"data": data},
-                update=update,
-                policy_step=policy_step,
-                env_time=env_time,
-                env_steps=update * rollout_steps * num_envs,
-                staleness=staleness_steps(stamp, policy_step),
-                stats=stats.drain(),
-            )
+            with _tracer.span("Time/block_send"):
+                ch.send(
+                    BLOCK_KIND,
+                    {"data": data},
+                    update=update,
+                    policy_step=policy_step,
+                    env_time=env_time,
+                    env_steps=update * rollout_steps * num_envs,
+                    staleness=staleness_steps(stamp, policy_step),
+                    stats=stats.drain(),
+                )
 
             # Lockstep publish pickup (the thread player's blocking param_q.get):
             # this is what makes the 1-actor schedule bit-identical.
-            payload, stamp = _await_params(ch, last_seq, spec.connect_timeout_s)
+            with _tracer.span("Time/param_wait"):
+                payload, stamp = _await_params(ch, last_seq, spec.connect_timeout_s)
             last_seq = int(stamp.get("seq", last_seq + 1))
             local_params = jax.device_put(payload)
+            _note_param_apply(fleet_exporter, stamp, policy_step)
+            if fleet_exporter is not None:
+                fleet_exporter.counter("env_steps", update * rollout_steps * num_envs)
+                fleet_exporter.counter("blocks", update)
+                fleet_exporter.counter("bytes_sent", ch.bytes_sent)
+                fleet_exporter.gauge("policy_step", policy_step)
         ch.send(DONE_KIND, None, env_steps=num_updates * rollout_steps * num_envs)
         ch.drain_until_closed(spec.connect_timeout_s)
     finally:
+        _actor_obs_teardown(fleet_exporter, actor_tracer)
         ch.close()
         envs.close()
 
@@ -672,6 +779,7 @@ def _run_ppo_learner(ctx, cfg, spec: PlacementSpec) -> None:
     save_config(cfg, Path(log_dir) / "config.yaml")
     logger = get_logger(cfg, log_dir)
     monitor = TrainingMonitor(cfg, log_dir)
+    fleet_exporter = maybe_exporter(cfg, "learner", generation=spec.generation, log_dir=log_dir)
 
     obs_space, act_space = _probe_spaces(cfg)
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
@@ -750,6 +858,7 @@ def _run_ppo_learner(ctx, cfg, spec: PlacementSpec) -> None:
         save_state=save_state,
         sps_env_steps=num_envs * rollout_steps,
         publish_empty_blocks=True,
+        fleet_exporter=fleet_exporter,
     )
 
 
@@ -768,6 +877,7 @@ def _learner_loop(
     save_state,
     sps_env_steps: int,
     publish_empty_blocks: bool = False,
+    fleet_exporter=None,
 ) -> None:
     """Algorithm-agnostic learner body: inbox consumption, publishing, metrics,
     checkpoint cadence, lifecycle accounting, and the exit summary.
@@ -802,6 +912,7 @@ def _learner_loop(
         last_checkpoint = policy_step
         return path
 
+    error: Optional[Dict[str, Any]] = None
     try:
         while len(done_slots) < spec.num_actors:
             try:
@@ -850,6 +961,18 @@ def _learner_loop(
             aggregator.update("Sebulba/xfer_bytes", float(meta.get("_wire_bytes", 0)))
             aggregator.update(f"Sebulba/xfer_bytes/ch{actor_id}", float(meta.get("_wire_bytes", 0)))
 
+            if fleet_exporter is not None:
+                # Dict writes only — the exporter's daemon thread owns the sends.
+                fleet_exporter.counter("grad_steps", cumulative_grad_steps)
+                fleet_exporter.counter("env_steps", slots.total)
+                fleet_exporter.counter("blocks", blocks)
+                fleet_exporter.counter("publishes", publisher.seq)
+                fleet_exporter.counter("bytes_published", publisher.bytes_published)
+                fleet_exporter.gauge("policy_step", policy_step)
+                fleet_exporter.gauge("Sebulba/queue_depth", inbox.qsize())
+                if meta.get("staleness") is not None:
+                    fleet_exporter.gauge("Sebulba/param_staleness_steps", float(meta["staleness"]))
+
             if logger is not None and (policy_step - last_log >= cfg.metric.log_every or cfg.dry_run):
                 metrics = aggregator.compute()
                 aggregator.reset()
@@ -867,25 +990,48 @@ def _learner_loop(
 
         if cfg.checkpoint.save_last:
             save_ckpt()
+    except BaseException as exc:
+        # A crashing learner must still leave its summary behind: the grad-step
+        # trace and lifecycle events are exactly what the chaos tests and
+        # sebulba_bench.py need to diagnose the death (satellite of this PR —
+        # previously only the happy path wrote it).
+        error = _exc_summary(exc)
+        raise
     finally:
         bytes_received = inbox.bytes_received()
+        if fleet_exporter is not None:
+            try:
+                # Before monitor.close(): the exporter ships the tracer's spans
+                # for the merged fleet Perfetto file, and close() deactivates it.
+                fleet_exporter.close()
+            except Exception:
+                pass
         inbox.close()
-        monitor.close()
-        _write_summary(
-            {
-                "wall_time_s": time.monotonic() - t_start,
-                "blocks": blocks,
-                "cumulative_grad_steps": cumulative_grad_steps,
-                "env_steps_total": slots.total,
-                "policy_step": policy_step,
-                "bytes_received": bytes_received,
-                "bytes_published": publisher.bytes_published,
-                "publishes": publisher.seq,
-                "grad_step_trace": grad_trace,
-                "events": inbox.events,
-                "t_start": t_start,
-            }
-        )
+        try:
+            # monitor.close() can itself raise (strict mode drains pending NaN
+            # trips there) — the summary write may not depend on it surviving.
+            monitor.close()
+        except BaseException as exc:
+            if error is None:
+                error = _exc_summary(exc)
+            raise
+        finally:
+            _write_summary(
+                {
+                    "wall_time_s": time.monotonic() - t_start,
+                    "blocks": blocks,
+                    "cumulative_grad_steps": cumulative_grad_steps,
+                    "env_steps_total": slots.total,
+                    "policy_step": policy_step,
+                    "bytes_received": bytes_received,
+                    "bytes_published": publisher.bytes_published,
+                    "publishes": publisher.seq,
+                    "grad_step_trace": grad_trace,
+                    "events": inbox.events,
+                    "t_start": t_start,
+                    "error": error,
+                }
+            )
     if logger is not None:
         logger.close()
 
@@ -903,16 +1049,41 @@ def _probe_spaces(cfg):
 
 
 # ----------------------------------------------------------------------- entry
+_RUNNERS = {
+    ("sac", "learner"): _run_sac_learner,
+    ("sac", "actor"): _run_sac_actor,
+    ("ppo", "learner"): _run_ppo_learner,
+    ("ppo", "actor"): _run_ppo_actor,
+}
+
+
 def run(ctx, cfg, spec: PlacementSpec, algo: str) -> None:
     """Role dispatch for a Sebulba child process (called from the decoupled
     algorithm ``main``s when ``distributed.mode=sebulba``)."""
-    runners = {
-        ("sac", "learner"): _run_sac_learner,
-        ("sac", "actor"): _run_sac_actor,
-        ("ppo", "learner"): _run_ppo_learner,
-        ("ppo", "actor"): _run_ppo_actor,
-    }
     key = (algo, spec.role)
-    if key not in runners:
+    if key not in _RUNNERS:
         raise ValueError(f"no sebulba runner for algo={algo!r} role={spec.role!r}")
-    runners[key](ctx, cfg, spec)
+    try:
+        _RUNNERS[key](ctx, cfg, spec)
+    except BaseException as exc:
+        # Learner crashes BEFORE _learner_loop (agent build, checkpoint resume,
+        # space probe) never reach the loop's summary-writing finally; leave a
+        # minimal error summary so the launcher/bench still learn what happened.
+        if spec.is_learner and not _summary_written:
+            _write_summary(
+                {
+                    "wall_time_s": 0.0,
+                    "blocks": 0,
+                    "cumulative_grad_steps": 0,
+                    "env_steps_total": 0,
+                    "policy_step": 0,
+                    "bytes_received": 0,
+                    "bytes_published": 0,
+                    "publishes": 0,
+                    "grad_step_trace": [],
+                    "events": [],
+                    "t_start": time.monotonic(),
+                    "error": _exc_summary(exc),
+                }
+            )
+        raise

@@ -116,6 +116,12 @@ def supervise_serve(overrides: List[str]) -> int:
     pure lost capacity.  Crashes back off exactly like training retries.
     """
     from sheeprl_tpu.config.core import compose
+    from sheeprl_tpu.obs.fleet import (
+        FLEET_ENV_VAR,
+        TRACE_ID_ENV_VAR,
+        FleetAggregator,
+        new_trace_id,
+    )
 
     cfg = compose(config_name="serve_cli", overrides=overrides)
     f_cfg = fault_cfg(cfg)
@@ -124,34 +130,67 @@ def supervise_serve(overrides: List[str]) -> int:
     base_backoff = float(f_cfg.get("backoff_s", 2.0))
     max_backoff = float(f_cfg.get("backoff_max_s", 60.0))
 
+    # Fleet telemetry across replica generations: replicas are stateless (no run
+    # dir), so the supervisor only hosts an aggregator when obs.fleet.dir pins an
+    # output location.  Each respawn reconnects to the same plane with a bumped
+    # generation, so `obs.top` shows the replica lineage in one slot.
+    fleet: Optional[Any] = None
+    trace_id = os.environ.get(TRACE_ID_ENV_VAR) or new_trace_id()
+    fleet_cfg = dict((cfg.get("obs") or {}).get("fleet") or {})
+    if bool(fleet_cfg.get("enabled", True)) and fleet_cfg.get("dir"):
+        try:
+            fleet = FleetAggregator(
+                str(fleet_cfg["dir"]),
+                liveness_timeout_s=float(fleet_cfg.get("liveness_timeout_s", 10.0)),
+                trace_id=trace_id,
+            )
+            _log(f"fleet telemetry at {fleet.address} -> {fleet_cfg['dir']}")
+        except OSError as e:
+            _log(f"fleet telemetry disabled: {e}")
+
     retries = 0
     preemptions = 0
-    while True:
-        env = dict(os.environ)
-        env[RESTARTS_ENV_VAR] = str(retries + preemptions)
-        _log(
-            f"serve attempt {retries + preemptions + 1} "
-            f"(retries={retries}/{max_retries}, preemptions={preemptions})"
-        )
-        proc = subprocess.run([sys.executable, "-m", "sheeprl_tpu.serve"] + overrides, env=env)
-        rc = proc.returncode
-        if rc == 0:
-            _log("replica shut down cleanly")
-            return 0
-        if rc == RESUMABLE_EXIT_CODE:
-            preemptions += 1
-            if max_preemptions is not None and preemptions > int(max_preemptions):
-                _log(f"exceeded fault.max_preemptions={max_preemptions}; giving up")
-                return rc
-            _log(f"replica drained on preemption (rc={rc}); respawning immediately")
-            continue
-        retries += 1
-        if retries > max_retries:
-            _log(f"exceeded fault.max_retries={max_retries}; giving up (rc={rc})")
-            return rc if rc else 1
-        delay = backoff_seconds(retries, base_backoff, max_backoff)
-        _log(f"replica died (rc={rc}); retry {retries}/{max_retries} in {delay:.1f}s")
-        time.sleep(delay)
+    try:
+        while True:
+            env = dict(os.environ)
+            env[RESTARTS_ENV_VAR] = str(retries + preemptions)
+            env[TRACE_ID_ENV_VAR] = trace_id
+            env.pop(FLEET_ENV_VAR, None)
+            if fleet is not None:
+                env[FLEET_ENV_VAR] = fleet.address
+            _log(
+                f"serve attempt {retries + preemptions + 1} "
+                f"(retries={retries}/{max_retries}, preemptions={preemptions})"
+            )
+            proc = subprocess.run([sys.executable, "-m", "sheeprl_tpu.serve"] + overrides, env=env)
+            rc = proc.returncode
+            if rc == 0:
+                _log("replica shut down cleanly")
+                return 0
+            if rc == RESUMABLE_EXIT_CODE:
+                preemptions += 1
+                if max_preemptions is not None and preemptions > int(max_preemptions):
+                    _log(f"exceeded fault.max_preemptions={max_preemptions}; giving up")
+                    return rc
+                _log(f"replica drained on preemption (rc={rc}); respawning immediately")
+                continue
+            retries += 1
+            if fleet is not None:
+                try:
+                    bundle = fleet.collect_blackboxes(f"serve_rc{rc}")
+                    if bundle:
+                        _log(f"fleet blackbox bundle: {bundle}")
+                except Exception as e:
+                    _log(f"fleet blackbox collection failed: {e}")
+            if retries > max_retries:
+                _log(f"exceeded fault.max_retries={max_retries}; giving up (rc={rc})")
+                return rc if rc else 1
+            delay = backoff_seconds(retries, base_backoff, max_backoff)
+            _log(f"replica died (rc={rc}); retry {retries}/{max_retries} in {delay:.1f}s")
+            time.sleep(delay)
+    finally:
+        if fleet is not None:
+            fleet.close()
 
 
 def supervise(args: Optional[List[str]] = None) -> int:

@@ -18,7 +18,13 @@ Layers (bottom-up):
                         dumped update step on CPU for deterministic repro;
 * ``monitor``         — ``TrainingMonitor``, the per-algorithm facade tying it
                         together and driving ``jax.profiler`` step annotations /
-                        capture windows.
+                        capture windows;
+* ``fleet``           — cross-process telemetry plane: per-process ``FleetExporter``
+                        pushing tagged metric rows over the Sebulba transport to a
+                        launcher-hosted ``FleetAggregator`` (merged timeline JSONL,
+                        live snapshot, merged Perfetto trace, fleet blackbox);
+* ``top``             — ``python -m sheeprl_tpu.obs.top``: live per-process fleet
+                        status table rendered from the aggregator snapshot.
 
 Import note: ``utils.timer`` imports ``obs.tracer`` at module load so every existing
 ``with timer(...)`` block doubles as a span — nothing in this package may import
@@ -26,7 +32,8 @@ Import note: ``utils.timer`` imports ``obs.tracer`` at module load so every exis
 (``flight_recorder`` is stdlib-only until a dump actually happens).
 """
 
-from sheeprl_tpu.obs import flight_recorder
+from sheeprl_tpu.obs import fleet, flight_recorder
+from sheeprl_tpu.obs.fleet import FleetAggregator, FleetExporter, maybe_exporter
 from sheeprl_tpu.obs.flight_recorder import FlightRecorder
 from sheeprl_tpu.obs.health import health_metrics, replay_age_metrics
 from sheeprl_tpu.obs.monitor import TrainingMonitor
@@ -37,11 +44,15 @@ from sheeprl_tpu.obs.watchdog import RecompileWarning, RecompileWatchdog
 __all__ = [
     "TrainingMonitor",
     "DeviceTelemetry",
+    "FleetAggregator",
+    "FleetExporter",
     "FlightRecorder",
     "SpanTracer",
     "RecompileWarning",
     "RecompileWatchdog",
+    "fleet",
     "flight_recorder",
+    "maybe_exporter",
     "get_active",
     "health_metrics",
     "replay_age_metrics",

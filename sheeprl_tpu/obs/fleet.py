@@ -1,0 +1,649 @@
+"""Fleet telemetry plane: cross-process metric export over the Sebulba transport.
+
+PR-13/14 made the repo multi-process (placed actor/learner topologies, supervised
+serve replicas) while observability stayed single-process: every process logged to
+its own TensorBoard dir and the learner summary JSON was the only cross-process
+artifact.  This module is the missing plane (Podracer, arXiv 2104.06272 §4;
+MindSpeed RL, arXiv 2507.19017 both stress fleet-wide queue/staleness/throughput
+visibility for actor-learner dataflow systems):
+
+* :class:`FleetExporter` — one per process.  Roles push *counters* (cumulative,
+  monotonic: grad steps, env steps, bytes) and *gauges* (instantaneous: queue
+  depth, staleness) into a lock-protected dict — O(dict write), no JAX, no host
+  sync — and a daemon thread flushes a tagged snapshot over the framed TCP
+  channel every ``obs.fleet.interval_s`` seconds.  Tags:
+  ``{role, actor_id, generation, host, pid, wall_clock, trace_id, seq}``.
+* :class:`FleetAggregator` — hosted by the launcher (``distributed/launcher.py``)
+  or the serving supervisor.  Merges every exporter's rows into ONE
+  ``<fleet_dir>/timeline.jsonl``, derives per-counter rates
+  (``<name>_per_s``), and keeps a live ``snapshot.json`` that
+  ``python -m sheeprl_tpu.obs.top`` renders.
+* **Correlated tracing** — every process under one launcher shares a run-level
+  trace id (``SHEEPRL_TPU_TRACE_ID``); at close each exporter ships its
+  ``SpanTracer`` events, and the aggregator rewrites their Chrome-trace ``pid``
+  to the real OS pid (process names labeled by role) so N processes merge into
+  ONE Perfetto timeline: ``<fleet_dir>/trace_fleet.json``.
+* **Fleet blackbox** — :meth:`FleetAggregator.collect_blackboxes` broadcasts a
+  dump request; each surviving exporter replies with its flight-recorder ring
+  *inline* (events are already JSON), and any on-disk ``blackbox/`` dumps from
+  dead peers are copied too — one correlated ``blackbox_fleet/`` crash bundle.
+
+A process with no aggregator to reach but ``obs.fleet.dir`` set spins up a
+private in-process aggregator and exports to it over localhost — standalone
+serve replicas and tests ride the exact code path the placed topology uses.
+
+Import cost is stdlib + numpy (via the transport): the launcher hosts the
+aggregator before any child touches JAX.  Telemetry must never kill training:
+every send is guarded, and a dead aggregator just stops the exporter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket as _socket
+import tempfile
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from sheeprl_tpu.distributed.transport import Channel, ChannelClosed, FramingError, Listener, connect
+from sheeprl_tpu.obs import flight_recorder as _flight_recorder
+
+#: ``host:port`` of the fleet aggregator; set by the launcher/supervisor on every
+#: child so exporters find their host without config surgery.
+FLEET_ENV_VAR = "SHEEPRL_TPU_FLEET"
+#: Run-level trace id shared by every process under one launcher — the join key
+#: for timeline rows, merged traces, and blackbox bundles.
+TRACE_ID_ENV_VAR = "SHEEPRL_TPU_TRACE_ID"
+
+HELLO_KIND = "fleet_hello"
+METRICS_KIND = "fleet_metrics"
+TRACE_KIND = "fleet_trace"
+BYE_KIND = "fleet_bye"
+DUMP_KIND = "fleet_dump"
+DUMP_DONE_KIND = "fleet_dump_done"
+
+#: Tag schema stamped on every timeline row (tests pin it; howto/observability.md).
+ROW_TAG_KEYS = ("role", "actor_id", "generation", "host", "pid", "wall_clock", "trace_id", "seq")
+
+
+def new_trace_id() -> str:
+    """Run-level trace id: sortable wall-clock prefix + launcher pid + entropy."""
+    return f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid():x}-{os.urandom(3).hex()}"
+
+
+def _fleet_cfg(cfg: Any) -> Dict[str, Any]:
+    try:
+        obs = cfg.get("obs") if hasattr(cfg, "get") else getattr(cfg, "obs", None)
+        section = (obs or {}).get("fleet")
+    except Exception:
+        section = None
+    return dict(section) if section else {}
+
+
+def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=f".{os.path.basename(path)}.", suffix=".tmp", dir=os.path.dirname(path) or ".")
+    with os.fdopen(fd, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+class _RateTracker:
+    """Derives ``<name>_per_s`` from consecutive cumulative-counter rows."""
+
+    def __init__(self) -> None:
+        self._prev: Optional[Tuple[float, Dict[str, float]]] = None
+
+    def derive(self, wall_clock: float, counters: Dict[str, float]) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        if self._prev is not None:
+            t0, prev = self._prev
+            dt = wall_clock - t0
+            if dt > 1e-6:
+                for name, value in counters.items():
+                    if name in prev:
+                        out[f"{name}_per_s"] = max(float(value) - float(prev[name]), 0.0) / dt
+        self._prev = (wall_clock, dict(counters))
+        return out
+
+
+def merge_chrome_traces(streams: List[Tuple[Dict[str, Any], List[Dict[str, Any]]]]) -> Dict[str, Any]:
+    """Merge per-process Chrome-trace event lists into ONE Perfetto document.
+
+    ``streams`` is ``[(tags, traceEvents), ...]``.  The per-process tracer uses
+    its *rank* as ``pid`` (every process says rank 0 locally), so the merge
+    rewrites every event's ``pid`` to the real OS pid from the tags and replaces
+    the ``process_name`` metadata with a role-labeled one — distinct tracks per
+    process, one timeline."""
+    merged: List[Dict[str, Any]] = []
+    for tags, events in streams:
+        pid = int(tags.get("pid", 0))
+        role = str(tags.get("role", "?"))
+        actor_id = tags.get("actor_id", 0)
+        label = f"{role}{actor_id}" if role == "actor" else role
+        merged.append(
+            {"name": "process_name", "ph": "M", "pid": pid, "args": {"name": f"{label} (pid {pid})"}}
+        )
+        for e in events:
+            if not isinstance(e, dict) or e.get("name") == "process_name":
+                continue
+            e = dict(e)
+            e["pid"] = pid
+            merged.append(e)
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+# ----------------------------------------------------------------------- host
+class FleetAggregator:
+    """The launcher/supervisor-side telemetry host: accept loop + one reader per
+    exporter, merged timeline JSONL, live snapshot, trace merge, blackbox bundles.
+
+    Processes are keyed by slot (``role`` + ``actor_id``) so a respawned actor
+    (new generation, new pid) *replaces* its predecessor's live row — exactly the
+    launcher's slot semantics — while the timeline keeps every generation's rows.
+    A slot whose channel closed and whose last row is older than
+    ``liveness_timeout_s`` is evicted from the snapshot (dead-exporter eviction);
+    its log dir is remembered for blackbox collection regardless."""
+
+    MAX_BUNDLES = 3  # crash-bundle cap: a respawn loop must not fill the disk
+
+    def __init__(
+        self,
+        fleet_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        liveness_timeout_s: float = 10.0,
+        trace_id: Optional[str] = None,
+    ):
+        self.fleet_dir = str(fleet_dir)
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self.trace_id = trace_id or os.environ.get(TRACE_ID_ENV_VAR) or new_trace_id()
+        self.liveness_timeout_s = float(liveness_timeout_s)
+        self.rows_written = 0
+        self._lock = threading.Lock()
+        self._procs: Dict[str, Dict[str, Any]] = {}
+        self._log_dirs: Dict[str, str] = {}  # survives eviction: blackbox sources
+        self._rates: Dict[str, _RateTracker] = {}
+        self._respawns: Dict[int, int] = {}
+        self._traces: List[Tuple[Dict[str, Any], List[Dict[str, Any]]]] = []
+        self._dump_results: List[Tuple[Dict[str, Any], List[Dict[str, Any]]]] = []
+        self._dump_pending = 0
+        self._dump_done = threading.Condition(self._lock)
+        self._bundles = 0
+        self._closed = False
+        self._timeline = open(os.path.join(self.fleet_dir, "timeline.jsonl"), "a")
+        self._listener = Listener(host, port)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fleet-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        return self._listener.address
+
+    @property
+    def timeline_path(self) -> str:
+        return os.path.join(self.fleet_dir, "timeline.jsonl")
+
+    @property
+    def snapshot_path(self) -> str:
+        return os.path.join(self.fleet_dir, "snapshot.json")
+
+    # ------------------------------------------------------------------ intake
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                ch = self._listener.accept(timeout=0.5)
+            except TimeoutError:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._reader, args=(ch,), daemon=True).start()
+
+    @staticmethod
+    def _slot_key(meta: Dict[str, Any]) -> str:
+        return f"{meta.get('role', '?')}{int(meta.get('actor_id', 0))}"
+
+    def _reader(self, ch: Channel) -> None:
+        key: Optional[str] = None
+        clean = False
+        try:
+            while True:
+                kind, meta, payload = ch.recv()
+                if kind == HELLO_KIND:
+                    key = self._register(ch, meta)
+                elif kind == METRICS_KIND:
+                    key = self._ingest(ch, meta, payload)
+                elif kind == TRACE_KIND:
+                    events = (payload or {}).get("traceEvents") or []
+                    with self._lock:
+                        self._traces.append((dict(meta), list(events)))
+                elif kind == DUMP_DONE_KIND:
+                    with self._lock:
+                        self._dump_results.append((dict(meta), list((payload or {}).get("events") or [])))
+                        self._dump_pending = max(self._dump_pending - 1, 0)
+                        self._dump_done.notify_all()
+                elif kind == BYE_KIND:
+                    clean = True
+                    break
+        except (ChannelClosed, FramingError, OSError):
+            pass
+        finally:
+            ch.close()
+            if key is not None:
+                with self._lock:
+                    proc = self._procs.get(key)
+                    if proc is not None and proc.get("channel") is ch:
+                        proc["alive"] = False
+                        proc["done"] = clean
+                    # an exporter that died mid-dump must not wedge the collector
+                    if self._dump_pending:
+                        self._dump_pending -= 1
+                        self._dump_done.notify_all()
+                self._write_snapshot()
+
+    def _register(self, ch: Channel, meta: Dict[str, Any]) -> str:
+        key = self._slot_key(meta)
+        tags = {k: meta.get(k) for k in ("role", "actor_id", "generation", "host", "pid", "trace_id")}
+        with self._lock:
+            stale = self._procs.get(key, {}).get("channel")
+            self._procs[key] = {
+                "tags": tags,
+                "channel": ch,
+                "alive": True,
+                "done": False,
+                "wall_clock": time.time(),
+                "metrics": {},
+            }
+            self._rates[key] = _RateTracker()
+            if meta.get("log_dir"):
+                self._log_dirs[f"{key}_g{tags.get('generation', 0)}_pid{tags.get('pid', 0)}"] = str(
+                    meta["log_dir"]
+                )
+        if stale is not None and stale is not ch:
+            stale.close()
+        self._write_snapshot()
+        return key
+
+    def _ingest(self, ch: Channel, meta: Dict[str, Any], payload: Any) -> str:
+        key = self._slot_key(meta)
+        counters = dict((payload or {}).get("counters") or {})
+        gauges = dict((payload or {}).get("gauges") or {})
+        wall_clock = float(meta.get("wall_clock", time.time()))
+        with self._lock:
+            if key not in self._procs:  # metrics before hello (shouldn't happen): register bare
+                self._procs[key] = {"tags": {}, "channel": ch, "alive": True, "done": False, "metrics": {}}
+                self._rates[key] = _RateTracker()
+            rates = self._rates[key].derive(wall_clock, counters)
+            metrics = {**counters, **gauges, **rates}
+            proc = self._procs[key]
+            proc["tags"] = {
+                k: meta.get(k) for k in ("role", "actor_id", "generation", "host", "pid", "trace_id")
+            }
+            proc["wall_clock"] = wall_clock
+            proc["alive"] = True
+            proc["metrics"] = metrics
+            row = {k: meta.get(k) for k in ROW_TAG_KEYS}
+            row["metrics"] = metrics
+            self._timeline.write(json.dumps(row) + "\n")
+            self._timeline.flush()
+            self.rows_written += 1
+        self._write_snapshot()
+        return key
+
+    # --------------------------------------------------------------- snapshot
+    def note_respawn(self, actor_id: int, count: int) -> None:
+        """Launcher hook: respawn counts ride the snapshot, not the exporters
+        (a respawned actor cannot know how many lives its slot already burned)."""
+        with self._lock:
+            self._respawns[int(actor_id)] = int(count)
+        self._write_snapshot()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Live fleet view; evicts slots that are dead *and* silent past the
+        liveness timeout (a closed channel alone is not eviction: a respawn may
+        be seconds away and ``top`` should show the gap, not a vanished row)."""
+        now = time.time()
+        with self._lock:
+            procs: Dict[str, Any] = {}
+            for key, proc in list(self._procs.items()):
+                age = now - float(proc.get("wall_clock", now))
+                alive = bool(proc.get("alive")) and age <= self.liveness_timeout_s
+                if not proc.get("alive") and not proc.get("done") and age > self.liveness_timeout_s:
+                    del self._procs[key]  # dead-exporter eviction
+                    continue
+                tags = dict(proc.get("tags") or {})
+                row = {
+                    **tags,
+                    "alive": alive,
+                    "done": bool(proc.get("done")),
+                    "age_s": round(age, 3),
+                    "wall_clock": proc.get("wall_clock"),
+                    "metrics": dict(proc.get("metrics") or {}),
+                }
+                if tags.get("role") == "actor":
+                    row["respawns"] = self._respawns.get(int(tags.get("actor_id", 0)), 0)
+                procs[key] = row
+            return {
+                "trace_id": self.trace_id,
+                "written": now,
+                "liveness_timeout_s": self.liveness_timeout_s,
+                "fleet_dir": self.fleet_dir,
+                "processes": procs,
+            }
+
+    def _write_snapshot(self) -> None:
+        try:
+            _atomic_write_json(self.snapshot_path, self.snapshot())
+        except OSError as e:  # pragma: no cover - disk trouble must not kill intake
+            warnings.warn(f"fleet: could not write snapshot: {e}")
+
+    # --------------------------------------------------------------- blackbox
+    def collect_blackboxes(self, reason: str, timeout_s: float = 5.0) -> Optional[str]:
+        """One correlated crash bundle: broadcast a dump request, gather every
+        surviving peer's flight-recorder ring (replied inline — events are
+        already JSON), and copy any on-disk ``blackbox/`` dumps (the dead
+        child's crash dump among them) into ``<parent>/blackbox_fleet/``."""
+        with self._lock:
+            if self._bundles >= self.MAX_BUNDLES:
+                return None
+            self._bundles += 1
+            bundle_n = self._bundles
+            self._dump_results = []
+            live = [
+                (key, proc["channel"])
+                for key, proc in self._procs.items()
+                if proc.get("alive") and proc.get("channel") is not None
+            ]
+        sent = 0
+        for _, ch in live:
+            try:
+                ch.send(DUMP_KIND, None, reason=str(reason))
+                sent += 1
+            except (ChannelClosed, OSError):
+                pass
+        with self._lock:
+            self._dump_pending = sent
+            deadline = time.monotonic() + timeout_s
+            while self._dump_pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._dump_done.wait(timeout=remaining)
+            results = list(self._dump_results)
+            log_dirs = dict(self._log_dirs)
+
+        slug = "".join(c if c.isalnum() else "_" for c in str(reason))[:48] or "event"
+        bundle = os.path.join(os.path.dirname(self.fleet_dir) or ".", "blackbox_fleet", f"{bundle_n:02d}_{slug}")
+        os.makedirs(bundle, exist_ok=True)
+        manifest: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "reason": str(reason),
+            "wall_clock": time.time(),
+            "peers": [],
+            "copied": [],
+        }
+        for meta, events in results:
+            key = f"{self._slot_key(meta)}_g{meta.get('generation', 0)}_pid{meta.get('pid', 0)}"
+            peer_dir = os.path.join(bundle, key)
+            os.makedirs(peer_dir, exist_ok=True)
+            try:
+                with open(os.path.join(peer_dir, "events.jsonl"), "w") as f:
+                    for event in events:
+                        f.write(json.dumps(event) + "\n")
+            except (OSError, TypeError, ValueError) as e:
+                warnings.warn(f"fleet: could not write peer ring for {key}: {e}")
+            manifest["peers"].append({"slot": key, "events": len(events)})
+        for key, log_dir in log_dirs.items():
+            src = os.path.join(log_dir, "blackbox")
+            if not os.path.isdir(src):
+                continue
+            try:
+                shutil.copytree(src, os.path.join(bundle, f"{key}_disk"), dirs_exist_ok=True)
+                manifest["copied"].append({"slot": key, "source": src})
+            except OSError as e:
+                warnings.warn(f"fleet: could not copy {src}: {e}")
+        _atomic_write_json(os.path.join(bundle, "manifest.json"), manifest)
+        return bundle
+
+    # ------------------------------------------------------------------ close
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # Merged Perfetto timeline from every trace stream shipped at exporter
+        # close: one file, one track per real pid.
+        with self._lock:
+            streams = list(self._traces)
+        if streams:
+            try:
+                with open(os.path.join(self.fleet_dir, "trace_fleet.json"), "w") as f:
+                    json.dump(merge_chrome_traces(streams), f)
+            except OSError as e:
+                warnings.warn(f"fleet: could not write merged trace: {e}")
+        self._write_snapshot()
+        self._listener.close()
+        with self._lock:
+            channels = [p.get("channel") for p in self._procs.values() if p.get("channel")]
+        for ch in channels:
+            ch.close()
+        try:
+            self._timeline.close()
+        except OSError:  # pragma: no cover
+            pass
+
+
+# --------------------------------------------------------------------- client
+_ACTIVE: Optional["FleetExporter"] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def get_active() -> Optional["FleetExporter"]:
+    return _ACTIVE
+
+
+def close_active(error: Optional[BaseException] = None) -> None:
+    """Crash-boundary hook (``cli.run_algorithm``): flush + close whatever
+    exporter this process has so the aggregator learns of a death from the
+    dying process itself, not just from the launcher's poll loop."""
+    with _ACTIVE_LOCK:
+        exporter = _ACTIVE
+    if exporter is None:
+        return
+    if error is not None:
+        exporter.gauge("crashed", 1.0)
+    exporter.close()
+
+
+class FleetExporter:
+    """Per-process telemetry pusher.  Hot-path API (:meth:`counter`,
+    :meth:`gauge`) is a dict write under a lock — safe inside a training loop,
+    no JAX, asserted sync-free under ``jax.transfer_guard("disallow")`` in the
+    tests.  A daemon thread owns every send."""
+
+    def __init__(
+        self,
+        tags: Dict[str, Any],
+        channel: Optional[Channel] = None,
+        interval_s: float = 2.0,
+        log_dir: Optional[str] = None,
+        own_aggregator: Optional[FleetAggregator] = None,
+    ):
+        self.tags = dict(tags)
+        self.interval_s = max(float(interval_s), 0.05)
+        self._ch = channel
+        self._own_aggregator = own_aggregator
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._seq = 0
+        self._closed = False
+        self._stop = threading.Event()
+        if self._ch is not None:
+            try:
+                self._ch.send(HELLO_KIND, None, **self.tags, log_dir=log_dir)
+            except (ChannelClosed, OSError):
+                self._ch = None
+        self._thread = threading.Thread(target=self._loop, name="fleet-export", daemon=True)
+        self._thread.start()
+        global _ACTIVE
+        with _ACTIVE_LOCK:
+            _ACTIVE = self
+
+    # ---------------------------------------------------------------- hot path
+    def counter(self, name: str, cumulative: Any) -> None:
+        """Record a cumulative monotonic counter; the aggregator derives
+        ``<name>_per_s`` between consecutive rows."""
+        with self._lock:
+            self._counters[str(name)] = float(cumulative)
+
+    def gauge(self, name: str, value: Any) -> None:
+        """Record an instantaneous value (latest wins within a flush window)."""
+        if value is None:
+            return
+        with self._lock:
+            self._gauges[str(name)] = float(value)
+
+    # ------------------------------------------------------------------ export
+    def _loop(self) -> None:
+        last_flush = time.monotonic()
+        while not self._stop.wait(0.05):
+            self._poll_inbound()
+            if time.monotonic() - last_flush >= self.interval_s:
+                last_flush = time.monotonic()
+                self.flush()
+
+    def _poll_inbound(self) -> None:
+        ch = self._ch
+        if ch is None:
+            return
+        try:
+            while ch.poll(0):
+                kind, meta, _ = ch.recv()
+                if kind == DUMP_KIND:
+                    self._reply_dump(str(meta.get("reason", "?")))
+        except (ChannelClosed, FramingError, OSError, TimeoutError):
+            self._ch = None
+
+    def _reply_dump(self, reason: str) -> None:
+        recorder = _flight_recorder.get_active()
+        events = recorder.events() if recorder is not None else []
+        _flight_recorder.record_event("fleet_dump", reason=reason)
+        ch = self._ch
+        if ch is None:
+            return
+        try:
+            ch.send(DUMP_DONE_KIND, {"events": events}, **self.tags, reason=reason)
+        except (ChannelClosed, OSError, TypeError):
+            pass
+
+    def flush(self) -> bool:
+        """Send one tagged metrics row (also the liveness heartbeat — an idle
+        process still flushes, so its snapshot row stays fresh); returns False
+        once the channel is gone."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            self._seq += 1
+            seq = self._seq
+        ch = self._ch
+        if ch is None:
+            return False
+        try:
+            ch.send(
+                METRICS_KIND,
+                {"counters": counters, "gauges": gauges},
+                **self.tags,
+                wall_clock=time.time(),
+                seq=seq,
+            )
+            return True
+        except (ChannelClosed, OSError):
+            self._ch = None
+            return False
+
+    def close(self) -> None:
+        """Final flush + trace shipment + goodbye.  Idempotent; never raises."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.flush()
+        ch = self._ch
+        if ch is not None:
+            try:
+                from sheeprl_tpu.obs import tracer as _tracer
+
+                active = _tracer.get_active()
+                if active is not None and len(active):
+                    ch.send(TRACE_KIND, {"traceEvents": active.chrome_trace()["traceEvents"]}, **self.tags)
+                ch.send(BYE_KIND, None, **self.tags)
+            except (ChannelClosed, OSError):
+                pass
+            ch.close()
+        global _ACTIVE
+        with _ACTIVE_LOCK:
+            if _ACTIVE is self:
+                _ACTIVE = None
+        if self._own_aggregator is not None:
+            self._own_aggregator.close()
+            self._own_aggregator = None
+
+
+def maybe_exporter(
+    cfg: Any,
+    role: str,
+    actor_id: int = 0,
+    generation: int = 0,
+    log_dir: Optional[str] = None,
+) -> Optional[FleetExporter]:
+    """Build this process's exporter, or ``None`` when no plane is configured.
+
+    Resolution order: ``SHEEPRL_TPU_FLEET`` (set by the launcher/supervisor) →
+    ``obs.fleet.dir`` (standalone: a private in-process aggregator writes the
+    same timeline/snapshot files) → off.  Any failure degrades to ``None`` —
+    telemetry must never take down the run it observes."""
+    fleet_cfg = _fleet_cfg(cfg)
+    if not bool(fleet_cfg.get("enabled", True)):
+        return None
+    interval_s = float(fleet_cfg.get("interval_s", 2.0))
+    tags = {
+        "role": str(role),
+        "actor_id": int(actor_id),
+        "generation": int(generation),
+        "host": _socket.gethostname(),
+        "pid": os.getpid(),
+        "trace_id": os.environ.get(TRACE_ID_ENV_VAR) or "",
+    }
+    addr = os.environ.get(FLEET_ENV_VAR, "")
+    own: Optional[FleetAggregator] = None
+    if addr:
+        host, _, port = addr.rpartition(":")
+        try:
+            ch = connect(host or "127.0.0.1", int(port), timeout_s=5.0)
+        except (ConnectionError, OSError, ValueError) as e:
+            warnings.warn(f"fleet: could not reach aggregator at {addr!r}: {e}")
+            return None
+    elif fleet_cfg.get("dir"):
+        try:
+            own = FleetAggregator(
+                str(fleet_cfg["dir"]),
+                liveness_timeout_s=float(fleet_cfg.get("liveness_timeout_s", 10.0)),
+            )
+            if not tags["trace_id"]:
+                tags["trace_id"] = own.trace_id
+            ch = connect(own._listener.host, own._listener.port, timeout_s=5.0)
+        except (ConnectionError, OSError) as e:
+            warnings.warn(f"fleet: could not start local aggregator: {e}")
+            if own is not None:
+                own.close()
+            return None
+    else:
+        return None
+    return FleetExporter(tags, channel=ch, interval_s=interval_s, log_dir=log_dir, own_aggregator=own)

@@ -1,0 +1,183 @@
+"""``python -m sheeprl_tpu.obs.top`` — live per-process fleet status.
+
+Renders the :class:`~sheeprl_tpu.obs.fleet.FleetAggregator` snapshot as a
+``top``-style table: one row per process slot with throughput (grad/env steps
+per second, derived aggregator-side from cumulative counters), queue depth,
+param staleness, respawn count, and serve SLO burn vs ``serve.slo_ms``.
+
+Usage::
+
+    python -m sheeprl_tpu.obs.top <fleet_dir> [--once] [--json] [--interval S]
+
+``<fleet_dir>`` is the directory the aggregator writes (default
+``<run_dir>/fleet`` under the launcher, or ``obs.fleet.dir``).  ``--once``
+prints a single frame and exits non-zero when the snapshot has no process rows,
+so CI can assert the plane actually carried telemetry.  Falls back to deriving
+a snapshot from the tail of ``timeline.jsonl`` when ``snapshot.json`` is
+missing (e.g. the aggregator died before its first atomic write).
+
+Stdlib-only on purpose: ``top`` must work on a machine that observes the fleet
+without being able to import JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+_COLUMNS = (
+    ("SLOT", 9),
+    ("ROLE", 8),
+    ("GEN", 4),
+    ("PID", 8),
+    ("ALIVE", 6),
+    ("AGE_S", 7),
+    ("GRAD/S", 8),
+    ("ENV/S", 9),
+    ("QDEPTH", 7),
+    ("STALE", 6),
+    ("RESPAWN", 8),
+    ("SLO%", 6),
+    ("P99MS", 8),
+)
+
+
+def load_snapshot(fleet_dir: str) -> Optional[Dict[str, Any]]:
+    """Read ``snapshot.json``; rebuild a minimal one from the timeline tail if
+    the snapshot is missing or unreadable."""
+    snap_path = os.path.join(fleet_dir, "snapshot.json")
+    try:
+        with open(snap_path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        pass
+    timeline = os.path.join(fleet_dir, "timeline.jsonl")
+    try:
+        with open(timeline) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    procs: Dict[str, Any] = {}
+    for line in lines:
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        key = f"{row.get('role', '?')}{int(row.get('actor_id', 0))}"
+        procs[key] = {
+            "role": row.get("role"),
+            "actor_id": row.get("actor_id"),
+            "generation": row.get("generation"),
+            "host": row.get("host"),
+            "pid": row.get("pid"),
+            "trace_id": row.get("trace_id"),
+            "wall_clock": row.get("wall_clock"),
+            "alive": False,  # no live aggregator to vouch for it
+            "metrics": row.get("metrics") or {},
+        }
+    if not procs:
+        return None
+    return {"fleet_dir": fleet_dir, "written": None, "processes": procs, "rebuilt_from_timeline": True}
+
+
+def _first(metrics: Dict[str, Any], *names: str) -> Optional[float]:
+    for name in names:
+        if name in metrics:
+            try:
+                return float(metrics[name])
+            except (TypeError, ValueError):
+                continue
+    return None
+
+
+def _fmt(value: Optional[float], width: int, digits: int = 1) -> str:
+    if value is None:
+        return "-".rjust(width)
+    if abs(value) >= 1000:
+        return f"{value:,.0f}".rjust(width)
+    return f"{value:.{digits}f}".rjust(width)
+
+
+def format_top(snapshot: Dict[str, Any], now: Optional[float] = None) -> str:
+    """Render the snapshot as a fixed-width table (pure function: tests call it
+    directly, the CLI loop just reprints it)."""
+    now = time.time() if now is None else now
+    lines: List[str] = []
+    trace_id = snapshot.get("trace_id") or "-"
+    written = snapshot.get("written")
+    age = f"{now - written:.1f}s ago" if isinstance(written, (int, float)) else "unknown"
+    lines.append(f"fleet {snapshot.get('fleet_dir', '?')}  trace_id={trace_id}  snapshot {age}")
+    header = " ".join(name.ljust(width) if i < 2 else name.rjust(width) for i, (name, width) in enumerate(_COLUMNS))
+    lines.append(header)
+    lines.append("-" * len(header))
+    procs = snapshot.get("processes") or {}
+    for key in sorted(procs, key=lambda k: ({"learner": 0, "actor": 1, "serve": 2}.get(procs[k].get("role"), 9), k)):
+        proc = procs[key]
+        metrics = proc.get("metrics") or {}
+        wall = proc.get("wall_clock")
+        age_s = (now - wall) if isinstance(wall, (int, float)) else None
+        slo_burn = _first(metrics, "Serve/slo_burn")
+        cells = [
+            key.ljust(_COLUMNS[0][1]),
+            str(proc.get("role", "?")).ljust(_COLUMNS[1][1]),
+            str(proc.get("generation", 0)).rjust(_COLUMNS[2][1]),
+            str(proc.get("pid", "-")).rjust(_COLUMNS[3][1]),
+            ("yes" if proc.get("alive") else ("done" if proc.get("done") else "DEAD")).rjust(_COLUMNS[4][1]),
+            _fmt(age_s, _COLUMNS[5][1]),
+            _fmt(_first(metrics, "grad_steps_per_s"), _COLUMNS[6][1]),
+            _fmt(_first(metrics, "env_steps_per_s"), _COLUMNS[7][1]),
+            _fmt(_first(metrics, "Sebulba/queue_depth", "Serve/queue_depth"), _COLUMNS[8][1], 0),
+            _fmt(_first(metrics, "Sebulba/param_staleness_steps"), _COLUMNS[9][1], 0),
+            str(proc.get("respawns", "-")).rjust(_COLUMNS[10][1]),
+            _fmt(None if slo_burn is None else slo_burn * 100.0, _COLUMNS[11][1]),
+            _fmt(_first(metrics, "Serve/latency_p99_ms"), _COLUMNS[12][1]),
+        ]
+        lines.append(" ".join(cells))
+    if not procs:
+        lines.append("(no processes reported yet)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.obs.top", description="live fleet telemetry view"
+    )
+    parser.add_argument("fleet_dir", help="aggregator output dir (contains snapshot.json / timeline.jsonl)")
+    parser.add_argument("--once", action="store_true", help="print one frame and exit (rc 2 when empty)")
+    parser.add_argument("--json", action="store_true", help="print the raw snapshot JSON instead of the table")
+    parser.add_argument("--interval", type=float, default=2.0, help="refresh period in seconds")
+    args = parser.parse_args(argv)
+
+    def frame() -> Optional[Dict[str, Any]]:
+        return load_snapshot(args.fleet_dir)
+
+    if args.once:
+        snapshot = frame()
+        if snapshot is None or not snapshot.get("processes"):
+            print(f"no fleet telemetry under {args.fleet_dir}", file=sys.stderr)
+            return 2
+        print(json.dumps(snapshot, indent=1) if args.json else format_top(snapshot))
+        return 0
+
+    try:
+        while True:
+            snapshot = frame()
+            out = (
+                json.dumps(snapshot, indent=1)
+                if args.json and snapshot is not None
+                else format_top(snapshot or {"fleet_dir": args.fleet_dir, "processes": {}})
+            )
+            sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

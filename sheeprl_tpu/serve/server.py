@@ -55,6 +55,7 @@ import numpy as np
 
 from sheeprl_tpu.distributed.transport import Channel, ChannelClosed, Listener
 from sheeprl_tpu.fault import preemption as fault_preemption
+from sheeprl_tpu.obs.fleet import maybe_exporter
 from sheeprl_tpu.serve.batching import bucket_ladder, collect_batch, pad_obs_batch, pick_bucket
 from sheeprl_tpu.serve.precompile import dispatch_key, precompile_ladder
 from sheeprl_tpu.serve.router import resolve_policy
@@ -89,6 +90,7 @@ class _Endpoint:
         self.accepted = 0
         self.replied = 0
         self.dropped = 0
+        self.slo_violations = 0  # replies whose end-to-end latency beat serve.slo_ms
         self.metrics = MetricAggregator(
             {
                 "Serve/latency_ms": "histogram",
@@ -116,6 +118,8 @@ class PolicyServer:
         self.drain_timeout_s = float(serve_cfg.drain_timeout_s)
         self.log_every_s = float(serve_cfg.log_every_s)
         self.greedy = bool(serve_cfg.greedy)
+        slo = serve_cfg.get("slo_ms", None)
+        self.slo_ms: Optional[float] = float(slo) if slo else None
         self.precision = _normalize_precision(serve_cfg.get("precision", "f32"))
         self.parity: Dict[str, Dict[str, Any]] = {}  # canonical -> parity stamp
         self._draining = False
@@ -130,6 +134,7 @@ class PolicyServer:
         self.precompile_seconds = 0.0
         self.watchdog = None
         self.rejected_draining = 0
+        self._fleet = None  # FleetExporter, attached in run()
 
         t0 = time.perf_counter()
         self._load_policies()
@@ -242,7 +247,15 @@ class PolicyServer:
             )
             t.start()
             self._threads.append(t)
+        # Fleet telemetry: the replica generation is the supervisor's restart
+        # counter, so respawned replicas land in a fresh snapshot slot lineage.
+        self._fleet = maybe_exporter(
+            self.cfg,
+            "serve",
+            generation=int(os.environ.get("SHEEPRL_TPU_FAULT_RESTARTS", "0") or 0),
+        )
         last_log = time.monotonic()
+        last_fleet = 0.0
         try:
             while not self._stop.is_set() and not fault_preemption.preemption_requested():
                 try:
@@ -262,9 +275,18 @@ class PolicyServer:
                 if self.log_every_s > 0 and time.monotonic() - last_log >= self.log_every_s:
                     last_log = time.monotonic()
                     self._log_status()
+                if self._fleet is not None and time.monotonic() - last_fleet >= 1.0:
+                    last_fleet = time.monotonic()
+                    self._fleet_update()
         finally:
             preempted = fault_preemption.preemption_requested()
             self._drain()
+            if self._fleet is not None:
+                self._fleet_update()  # final counters cover the drained queue
+                try:
+                    self._fleet.close()
+                except Exception:
+                    pass
             self._write_summary(preempted=preempted)
             self._close()
         return fault_preemption.RESUMABLE_EXIT_CODE if preempted else 0
@@ -383,6 +405,8 @@ class PolicyServer:
         ep.metrics.update("Serve/dispatches", 1.0)
         latencies = [(t1 - r.t_enq) * 1000.0 for r in batch]
         ep.metrics.update("Serve/latency_ms", latencies)
+        if self.slo_ms is not None:
+            ep.slo_violations += sum(1 for lat in latencies if lat > self.slo_ms)
         hist = ep.metrics.metrics["Serve/latency_ms"].compute()
         p99 = float(hist["p99"]) if hist else float("nan")
         for i, req in enumerate(batch):
@@ -423,6 +447,37 @@ class PolicyServer:
         for ch in channels:
             ch.close()
 
+    def _fleet_update(self) -> None:
+        """Push replica-wide counters/gauges to the fleet plane.  Dict writes +
+        one framed send on the exporter's own thread — nothing here touches the
+        dispatchers' hot path."""
+        exporter = self._fleet
+        if exporter is None:
+            return
+        accepted = sum(ep.accepted for ep in self.endpoints.values())
+        replied = sum(ep.replied for ep in self.endpoints.values())
+        dropped = sum(ep.dropped for ep in self.endpoints.values())
+        dispatches = sum(ep.dispatch_counter for ep in self.endpoints.values())
+        violations = sum(ep.slo_violations for ep in self.endpoints.values())
+        exporter.counter("requests_accepted", accepted)
+        exporter.counter("requests_replied", replied)
+        exporter.counter("requests_dropped", dropped)
+        exporter.counter("dispatches", dispatches)
+        exporter.counter("slo_violations", violations)
+        exporter.gauge("Serve/queue_depth", sum(ep.queue.qsize() for ep in self.endpoints.values()))
+        if self.slo_ms is not None:
+            exporter.gauge("Serve/slo_ms", self.slo_ms)
+            exporter.gauge("Serve/slo_burn", violations / max(replied, 1))
+        p99 = float("nan")
+        for ep in self.endpoints.values():
+            hist = ep.metrics.metrics["Serve/latency_ms"].compute()
+            if hist:
+                p = float(hist["p99"])
+                if not (p99 == p99) or p > p99:  # max over endpoints, NaN-safe
+                    p99 = p
+        if p99 == p99:
+            exporter.gauge("Serve/latency_p99_ms", p99)
+
     def _log_status(self) -> None:
         for ep in self.endpoints.values():
             computed = ep.metrics.compute()
@@ -458,15 +513,21 @@ class PolicyServer:
                 "replied": ep.replied,
                 "dropped": ep.dropped,
                 "dispatches": ep.dispatch_counter,
+                "slo_violations": ep.slo_violations,
                 "metrics": ep.metrics.compute(),
             }
+        total_replied = sum(ep.replied for ep in self.endpoints.values())
+        total_violations = sum(ep.slo_violations for ep in self.endpoints.values())
         return {
             "preempted": bool(preempted),
             "drained": True,
             "rejected_draining": self.rejected_draining,
             "accepted": sum(ep.accepted for ep in self.endpoints.values()),
-            "replied": sum(ep.replied for ep in self.endpoints.values()),
+            "replied": total_replied,
             "dropped": sum(ep.dropped for ep in self.endpoints.values()),
+            "slo_ms": self.slo_ms,
+            "slo_violations": total_violations,
+            "slo_burn": total_violations / max(total_replied, 1),
             "recompiles": int(self.watchdog.recompiles) if self.watchdog else 0,
             "startup_seconds": self.startup_seconds,
             "precompile_seconds": self.precompile_seconds,

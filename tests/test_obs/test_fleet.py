@@ -1,0 +1,545 @@
+"""Fleet telemetry plane (ISSUE 16): aggregator merge semantics, tag schema,
+snapshot liveness + dead-exporter eviction, blackbox bundles, the `top` view,
+hot-path hygiene (zero host syncs, ≤2% step overhead), and — slow tier — a real
+2-actor launcher run producing one merged timeline + one Perfetto file."""
+
+import importlib.util
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sheeprl_tpu.distributed.transport import connect
+from sheeprl_tpu.obs import flight_recorder as flight_recorder_mod
+from sheeprl_tpu.obs import top as fleet_top
+from sheeprl_tpu.obs.fleet import (
+    FLEET_ENV_VAR,
+    ROW_TAG_KEYS,
+    TRACE_ID_ENV_VAR,
+    FleetAggregator,
+    FleetExporter,
+    maybe_exporter,
+    merge_chrome_traces,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+
+
+def _load_bench_module(name):
+    spec = importlib.util.spec_from_file_location(name, REPO / "benchmarks" / f"{name}.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _wait_for(predicate, timeout_s=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _timeline_rows(agg):
+    rows = []
+    try:
+        with open(agg.timeline_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    except OSError:
+        pass
+    return rows
+
+
+def _exporter(agg, role, actor_id=0, generation=0, interval_s=60.0, log_dir=None):
+    """A client exporter wired to ``agg`` with a long interval: tests drive
+    flushes explicitly so assertions never race the heartbeat."""
+    host, port = agg.address.rsplit(":", 1)
+    tags = {
+        "role": role,
+        "actor_id": actor_id,
+        "generation": generation,
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "trace_id": agg.trace_id,
+    }
+    ch = connect(host, int(port), timeout_s=5.0)
+    return FleetExporter(tags, channel=ch, interval_s=interval_s, log_dir=log_dir)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet_env(monkeypatch):
+    monkeypatch.delenv(FLEET_ENV_VAR, raising=False)
+    monkeypatch.delenv(TRACE_ID_ENV_VAR, raising=False)
+
+
+# --------------------------------------------------------------- merge + tags
+def test_timeline_rows_carry_full_tag_schema_and_rates(tmp_path):
+    """Every timeline row is stamped with the pinned tag schema, rows from
+    several processes merge into ONE file, and cumulative counters are folded
+    into ``<name>_per_s`` rates between consecutive rows of the same slot."""
+    agg = FleetAggregator(str(tmp_path / "fleet"), trace_id="tid-test")
+    try:
+        learner = _exporter(agg, "learner")
+        actor = _exporter(agg, "actor", actor_id=1)
+        learner.counter("grad_steps", 0)
+        assert learner.flush()
+        actor.counter("env_steps", 100)
+        assert actor.flush()
+        time.sleep(0.25)
+        learner.counter("grad_steps", 50)
+        learner.gauge("Sebulba/queue_depth", 2)
+        assert learner.flush()
+        _wait_for(lambda: agg.rows_written >= 3, msg="3 timeline rows")
+        learner.close()
+        actor.close()
+
+        rows = _timeline_rows(agg)
+        assert len(rows) >= 3
+        for row in rows:
+            assert set(ROW_TAG_KEYS) <= set(row), f"row missing tags: {sorted(row)}"
+            assert row["trace_id"] == "tid-test"
+            assert isinstance(row["metrics"], dict)
+        roles = {(r["role"], r["actor_id"]) for r in rows}
+        assert ("learner", 0) in roles and ("actor", 1) in roles
+
+        learner_rows = [r for r in rows if r["role"] == "learner"]
+        rated = [r for r in learner_rows if "grad_steps_per_s" in r["metrics"]]
+        assert rated, "no derived grad_steps_per_s rate on any learner row"
+        # 50 grad steps over ~0.25s: the rate is large and positive, never the
+        # raw cumulative value.
+        assert rated[0]["metrics"]["grad_steps_per_s"] > 0
+        assert any(r["metrics"].get("Sebulba/queue_depth") == 2 for r in learner_rows)
+        # seq increases monotonically per process
+        seqs = [r["seq"] for r in learner_rows]
+        assert seqs == sorted(seqs)
+    finally:
+        agg.close()
+
+
+def test_respawned_actor_replaces_its_slot_row(tmp_path):
+    """Slot semantics: a respawned actor (same actor_id, new generation) takes
+    over its predecessor's snapshot row; respawn counts ride the snapshot via
+    the launcher's ``note_respawn`` hook."""
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    try:
+        gen0 = _exporter(agg, "actor", actor_id=0, generation=0)
+        assert gen0.flush()
+        _wait_for(lambda: "actor0" in agg.snapshot()["processes"], msg="gen0 registered")
+        gen0.close()
+
+        agg.note_respawn(0, 1)
+        gen1 = _exporter(agg, "actor", actor_id=0, generation=1)
+        assert gen1.flush()
+        _wait_for(
+            lambda: agg.snapshot()["processes"].get("actor0", {}).get("generation") == 1,
+            msg="gen1 took over the slot",
+        )
+        snap = agg.snapshot()
+        assert list(snap["processes"]) == ["actor0"], "respawn must replace, not duplicate"
+        row = snap["processes"]["actor0"]
+        assert row["alive"] is True
+        assert row["respawns"] == 1
+        gen1.close()
+    finally:
+        agg.close()
+
+
+def test_snapshot_liveness_and_dead_exporter_eviction(tmp_path):
+    """A clean BYE keeps the row (done=True); an abrupt channel death keeps the
+    row only until ``liveness_timeout_s`` — then it is evicted."""
+    agg = FleetAggregator(str(tmp_path / "fleet"), liveness_timeout_s=0.3)
+    try:
+        clean = _exporter(agg, "learner")
+        dead = _exporter(agg, "actor", actor_id=1)
+        assert clean.flush() and dead.flush()
+        _wait_for(lambda: len(agg.snapshot()["processes"]) == 2, msg="both registered")
+
+        clean.close()  # BYE -> done
+        dead._ch.close()  # simulated crash: no BYE
+        _wait_for(
+            lambda: not agg.snapshot()["processes"].get("actor1", {}).get("alive", True),
+            msg="reader noticed the dead channel",
+        )
+        snap = agg.snapshot()
+        assert snap["processes"]["learner0"]["done"] is True
+        assert "actor1" in snap["processes"], "dead slot evicted before the timeout"
+
+        time.sleep(0.4)
+        snap = agg.snapshot()
+        assert "actor1" not in snap["processes"], "dead+silent slot not evicted"
+        assert "learner0" in snap["processes"], "clean-done slot must survive eviction"
+        dead.close()
+    finally:
+        agg.close()
+
+
+def test_merge_chrome_traces_rewrites_pids():
+    """Per-process tracers all say rank-0 pid; the merge maps each stream to its
+    real OS pid with a role-labeled process_name — one Perfetto doc, N tracks."""
+    ev = {"name": "Time/update", "ph": "X", "pid": 0, "tid": 0, "ts": 1, "dur": 5}
+    merged = merge_chrome_traces(
+        [
+            ({"role": "learner", "actor_id": 0, "pid": 111}, [dict(ev)]),
+            ({"role": "actor", "actor_id": 1, "pid": 222}, [dict(ev)]),
+        ]
+    )
+    events = merged["traceEvents"]
+    assert {e["pid"] for e in events if e.get("ph") == "X"} == {111, 222}
+    names = {e["pid"]: e["args"]["name"] for e in events if e.get("name") == "process_name"}
+    assert names[111] == "learner (pid 111)"
+    assert names[222] == "actor1 (pid 222)"
+
+
+# ----------------------------------------------------------------- blackboxes
+def test_fleet_blackbox_bundle(tmp_path):
+    """collect_blackboxes gathers every survivor's flight-recorder ring inline,
+    copies on-disk blackbox dumps from remembered log dirs, writes a manifest,
+    and caps the number of bundles."""
+    log_dir = tmp_path / "actor_logs"
+    (log_dir / "blackbox").mkdir(parents=True)
+    (log_dir / "blackbox" / "events.jsonl").write_text('{"kind": "span"}\n')
+
+    recorder = flight_recorder_mod.FlightRecorder(
+        log_dir=str(tmp_path / "rec"), capacity=64, keep_events=32, algo="test", cfg={}
+    )
+    flight_recorder_mod.install(recorder)
+    try:
+        flight_recorder_mod.record_event("metric_flush", step=7)
+        agg = FleetAggregator(str(tmp_path / "run" / "fleet"))
+        try:
+            exp = _exporter(agg, "learner", log_dir=str(log_dir))
+            assert exp.flush()
+            _wait_for(lambda: agg.rows_written >= 1, msg="row ingested")
+
+            bundle = agg.collect_blackboxes("actor1_rc9")
+            assert bundle is not None
+            bundle = pathlib.Path(bundle)
+            assert bundle.parent.name == "blackbox_fleet"
+            manifest = json.loads((bundle / "manifest.json").read_text())
+            assert manifest["reason"] == "actor1_rc9"
+            assert manifest["trace_id"] == agg.trace_id
+            assert manifest["peers"], "no surviving peer replied with its ring"
+            peer_dir = bundle / manifest["peers"][0]["slot"]
+            events = [
+                json.loads(line)
+                for line in (peer_dir / "events.jsonl").read_text().splitlines()
+            ]
+            assert any(e.get("kind") == "metric_flush" for e in events)
+            # the dead child's on-disk dump came along via the hello's log_dir
+            disk_copies = list(bundle.glob("*_disk"))
+            assert disk_copies and (disk_copies[0] / "events.jsonl").is_file()
+            # the ring is a copy, not a consumed one-shot: dump_active still works
+            assert flight_recorder_mod.get_active() is recorder
+
+            assert agg.collect_blackboxes("two") is not None
+            assert agg.collect_blackboxes("three") is not None
+            assert agg.collect_blackboxes("four") is None, "bundle cap not enforced"
+            exp.close()
+        finally:
+            agg.close()
+    finally:
+        flight_recorder_mod.install(None)
+
+
+# -------------------------------------------------------------- maybe_exporter
+def test_maybe_exporter_disabled_and_unconfigured(tmp_path):
+    assert maybe_exporter({"obs": {"fleet": {"enabled": False, "dir": str(tmp_path)}}}, "learner") is None
+    assert maybe_exporter({"obs": {"fleet": {"enabled": True}}}, "learner") is None
+    assert maybe_exporter({}, "learner") is None
+
+
+def test_maybe_exporter_local_dir_mode(tmp_path):
+    """No launcher address, but ``obs.fleet.dir`` set: the process hosts a
+    private in-process aggregator and exports to it over localhost — the same
+    files, the same code path (standalone serve replicas, tests)."""
+    fleet_dir = tmp_path / "fleet"
+    cfg = {"obs": {"fleet": {"enabled": True, "dir": str(fleet_dir), "interval_s": 60.0}}}
+    exporter = maybe_exporter(cfg, "serve", generation=2)
+    assert exporter is not None
+    try:
+        exporter.counter("requests_replied", 10)
+        assert exporter.flush()
+        _wait_for(lambda: (fleet_dir / "timeline.jsonl").exists(), msg="timeline created")
+        _wait_for(
+            lambda: any(
+                r.get("role") == "serve"
+                for r in (
+                    json.loads(line)
+                    for line in (fleet_dir / "timeline.jsonl").read_text().splitlines()
+                    if line.strip()
+                )
+            ),
+            msg="serve row written",
+        )
+    finally:
+        exporter.close()
+    rows = [
+        json.loads(line)
+        for line in (fleet_dir / "timeline.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    assert rows and rows[0]["role"] == "serve" and rows[0]["generation"] == 2
+    assert (fleet_dir / "snapshot.json").exists()
+
+
+# ------------------------------------------------------------------ hot path
+def test_exporter_hot_path_no_host_sync(tmp_path):
+    """The per-step API (counter/gauge) must not force a device→host sync: a
+    jitted step keeps executing under ``transfer_guard("disallow")`` while the
+    loop records telemetry (PR-4 health-diagnostics pattern)."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    exporter = _exporter(agg, "learner")
+    try:
+        step = jax.jit(lambda x: x * 1.0001 + 0.1)
+        x = jax.device_put(jnp.ones((32, 32), jnp.float32))
+        x = step(x)
+        jax.block_until_ready(x)  # compile outside the guard
+        with jax.transfer_guard("disallow"):
+            for i in range(20):
+                x = step(x)
+                exporter.counter("grad_steps", i)
+                exporter.counter("env_steps", i * 64)
+                exporter.gauge("Sebulba/queue_depth", i % 3)
+        jax.block_until_ready(x)
+        assert exporter.flush()
+        _wait_for(lambda: agg.rows_written >= 1, msg="row after guarded loop")
+    finally:
+        exporter.close()
+        agg.close()
+
+
+def test_export_overhead_under_two_percent():
+    """Acceptance: the telemetry plane costs ≤2% of step time against a LIVE
+    loopback aggregator (same bench that emits ``obs_fleet_overhead_pct``)."""
+    bench = _load_bench_module("obs_overhead_bench")
+    rows = [bench.run_bench(steps=200, step_ms=2.0, repeats=2) for _ in range(3)]
+    best = min(r["value"] for r in rows)
+    assert best <= 2.0, f"fleet export overhead {best:.2f}% > 2% (rows: {rows})"
+
+
+# -------------------------------------------------------- learner summary path
+def test_learner_summary_written_on_exception(tmp_path, monkeypatch):
+    """A learner that dies before (or inside) its loop still leaves a summary
+    JSON with the failure — previously only the happy path wrote it."""
+    from sheeprl_tpu.distributed import sebulba
+    from sheeprl_tpu.distributed.placement import SUMMARY_ENV_VAR, PlacementSpec
+
+    summary_path = tmp_path / "summary.json"
+    monkeypatch.setenv(SUMMARY_ENV_VAR, str(summary_path))
+    monkeypatch.setattr(sebulba, "_summary_written", False)
+
+    def _boom(ctx, cfg, spec):
+        raise RuntimeError("learner setup exploded")
+
+    monkeypatch.setitem(sebulba._RUNNERS, ("sac", "learner"), _boom)
+    spec = PlacementSpec(mode="sebulba", role="learner")
+    with pytest.raises(RuntimeError, match="exploded"):
+        sebulba.run(None, {}, spec, "sac")
+    summary = json.loads(summary_path.read_text())
+    assert summary["error"]["type"] == "RuntimeError"
+    assert "exploded" in summary["error"]["message"]
+    assert summary["blocks"] == 0 and summary["cumulative_grad_steps"] == 0
+
+
+# ------------------------------------------------------------------- top view
+def test_top_once_renders_snapshot(tmp_path, capsys):
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    try:
+        learner = _exporter(agg, "learner")
+        learner.counter("grad_steps", 0)
+        learner.flush()
+        time.sleep(0.15)
+        learner.counter("grad_steps", 30)
+        learner.gauge("Sebulba/queue_depth", 4)
+        learner.flush()
+        _wait_for(lambda: agg.rows_written >= 2, msg="rows for top")
+        learner.close()
+    finally:
+        agg.close()
+
+    rc = fleet_top.main([str(tmp_path / "fleet"), "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "learner0" in out and "GRAD/S" in out and "QDEPTH" in out
+
+    empty = tmp_path / "nothing"
+    empty.mkdir()
+    assert fleet_top.main([str(empty), "--once"]) == 2
+
+
+def test_top_rebuilds_from_timeline_tail(tmp_path):
+    """snapshot.json missing (aggregator died pre-write): top falls back to the
+    timeline tail and marks every row not-alive."""
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+    row = {k: None for k in ROW_TAG_KEYS}
+    row.update(role="actor", actor_id=1, generation=0, pid=42, wall_clock=time.time(), seq=3)
+    row["metrics"] = {"env_steps_per_s": 12.5}
+    (fleet_dir / "timeline.jsonl").write_text(json.dumps(row) + "\n")
+    snap = fleet_top.load_snapshot(str(fleet_dir))
+    assert snap is not None and snap.get("rebuilt_from_timeline")
+    assert snap["processes"]["actor1"]["alive"] is False
+    table = fleet_top.format_top(snap)
+    assert "actor1" in table and "12.5" in table
+
+
+# ----------------------------------------------------------- trace_summary tie
+def test_trace_summary_folds_fleet_timeline(tmp_path):
+    trace_summary = _load_bench_module("trace_summary")
+    timeline = tmp_path / "timeline.jsonl"
+    rows = []
+    for i, wall in enumerate((100.0, 101.0)):
+        rows.append(
+            {
+                "role": "learner",
+                "actor_id": 0,
+                "generation": 0,
+                "host": "h",
+                "pid": 7,
+                "wall_clock": wall,
+                "trace_id": "tid",
+                "seq": i + 1,
+                "metrics": {
+                    "grad_steps_per_s": 40.0 * i,
+                    "Sebulba/publish_apply_ms": 3.0 + i,
+                },
+            }
+        )
+    rows.append(
+        {
+            "role": "actor",
+            "actor_id": 0,
+            "generation": 1,
+            "host": "h",
+            "pid": 8,
+            "wall_clock": 101.5,
+            "trace_id": "tid",
+            "seq": 1,
+            "metrics": {"env_steps_per_s": 512.0, "Sebulba/param_staleness_steps": 2.0},
+        }
+    )
+    timeline.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+    summary = trace_summary.summarize(str(timeline))
+    assert summary["trace_id"] == "tid" and summary["rows"] == 3
+    slots = summary["slots"]
+    assert list(slots) == ["learner0", "actor0"]  # learner sorts first
+    assert slots["learner0"]["rates"]["grad_steps_per_s"] == 40.0  # peak, not last
+    assert slots["learner0"]["publish_apply_ms_mean"] == pytest.approx(3.5)
+    assert slots["actor0"]["generations"] == [1]
+    table = trace_summary.format_fleet_table(summary)
+    assert "pub->apply_ms" in table and "learner0" in table
+
+    # a merged multi-pid chrome trace groups phases per process
+    doc = merge_chrome_traces(
+        [
+            ({"role": "learner", "actor_id": 0, "pid": 7},
+             [{"name": "Time/update", "ph": "X", "pid": 0, "tid": 0, "ts": 1, "dur": 1000, "args": {"depth": 0}}]),
+            ({"role": "actor", "actor_id": 0, "pid": 8},
+             [{"name": "Time/env_interaction", "ph": "X", "pid": 0, "tid": 0, "ts": 1, "dur": 2000, "args": {"depth": 0}}]),
+        ]
+    )
+    trace_path = tmp_path / "trace_fleet.json"
+    trace_path.write_text(json.dumps(doc))
+    merged = trace_summary.summarize(str(trace_path))
+    assert set(merged["phases"]) == {
+        "[learner (pid 7)] Time/update",
+        "[actor0 (pid 8)] Time/env_interaction",
+    }
+
+
+# ------------------------------------------------------------------ slow e2e
+@pytest.mark.slow
+def test_fleet_two_actor_launcher_e2e(tmp_path):
+    """The acceptance run: a real 2-actor SAC launcher topology exports one
+    merged timeline with rows from every role, ships every process's spans into
+    ONE Perfetto file, and `obs.top --once` renders the snapshot."""
+    fleet_dir = tmp_path / "fleet"
+    overrides = [
+        "exp=sac_decoupled",
+        "env=continuous_dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.hidden_size=8",
+        "algo.per_rank_batch_size=8",
+        "algo.learning_starts=4",
+        "algo.total_steps=16",
+        "buffer.size=256",
+        "dry_run=False",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.run_test=False",
+        "checkpoint.every=100000",
+        "checkpoint.save_last=False",
+        "metric.log_every=4",
+        "buffer.memmap=False",
+        f"log_root={tmp_path}/logs",
+        "distributed.num_actors=2",
+        "distributed.connect_timeout_s=30",
+        "obs.enabled=True",  # tracers on -> every process ships spans
+        "obs.fleet.interval_s=0.5",
+        f"obs.fleet.dir={fleet_dir}",
+    ]
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        SHEEPRL_TPU_QUIET="1",
+    )
+    env.pop(FLEET_ENV_VAR, None)
+    env.pop(TRACE_ID_ENV_VAR, None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu.sebulba", *overrides],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, f"launcher failed rc={proc.returncode}:\n{proc.stdout[-4000:]}"
+
+    timeline = fleet_dir / "timeline.jsonl"
+    assert timeline.is_file(), f"no fleet timeline; launcher output:\n{proc.stdout[-2000:]}"
+    rows = [json.loads(line) for line in timeline.read_text().splitlines() if line.strip()]
+    assert rows, "fleet timeline is empty"
+    slots = {f"{r['role']}{r['actor_id']}" for r in rows}
+    assert {"learner0", "actor0", "actor1"} <= slots, f"missing roles: {slots}"
+    trace_ids = {r["trace_id"] for r in rows}
+    assert len(trace_ids) == 1, f"rows not correlated under one trace id: {trace_ids}"
+    for row in rows:
+        assert set(ROW_TAG_KEYS) <= set(row)
+
+    # ONE Perfetto file spanning all three processes' real pids.
+    trace_path = fleet_dir / "trace_fleet.json"
+    assert trace_path.is_file(), f"no merged trace; launcher output:\n{proc.stdout[-2000:]}"
+    doc = json.loads(trace_path.read_text())
+    pids = {e.get("pid") for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert len(pids) >= 3, f"merged trace spans {len(pids)} pids, expected >= 3"
+    row_pids = {r["pid"] for r in rows}
+    assert pids <= row_pids, "trace pids are not the exporters' real OS pids"
+
+    # the live view renders it
+    top = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu.obs.top", str(fleet_dir), "--once"],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=60,
+    )
+    assert top.returncode == 0, f"obs.top --once failed:\n{top.stdout}"
+    assert "learner0" in top.stdout and "actor1" in top.stdout
