@@ -39,15 +39,18 @@ _LOWER_BETTER_HINTS = ("ms", "latency", "time", "seconds")
 # (benchmarks/anakin_bench.py), sebulba_* row (benchmarks/sebulba_bench.py),
 # serve_* row (benchmarks/serve_bench.py) and precision_* row
 # (benchmarks/precision_bench.py — parity/agreement fractions AND the bf16/int8
-# throughputs ride the anakin_/serve_ prefixes) is higher-better regardless of
-# what its unit string mentions...
-_HIGHER_BETTER_PREFIXES = ("anakin_", "sebulba_", "serve_", "precision_")
+# throughputs ride the anakin_/serve_ prefixes) and fleet_* row
+# (benchmarks/fleet_bench.py) is higher-better regardless of what its unit
+# string mentions...
+_HIGHER_BETTER_PREFIXES = ("anakin_", "sebulba_", "serve_", "precision_", "fleet_")
 # ...EXCEPT the wall-clock/latency rows, which are durations: exact-name pins
-# win over the prefix pins (serve_p99_ms is a latency SLO, serve_startup_seconds
-# is the cold/warm replica start time — both regress when they RISE).
+# win over the prefix pins (serve_p99_ms / fleet_p99_ms are latency SLOs,
+# serve_startup_seconds is the cold/warm replica start time — all regress when
+# they RISE).
 _LOWER_BETTER_METRICS = (
     "anakin_compile_seconds",
     "checkpoint_save_seconds",
+    "fleet_p99_ms",
     "obs_fleet_overhead_pct",
     "resume_restore_seconds",
     "serve_p99_ms",

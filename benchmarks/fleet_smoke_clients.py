@@ -1,0 +1,169 @@
+"""CI fleet-smoke client driver (.github/workflows/cpu-tests.yaml "Fleet smoke").
+
+Drives a running serving fleet (front + >= 2 replicas under the fleet
+supervisor) through its front, then SIGKILLs one replica *while its requests
+are in flight* and keeps driving: the front must reroute the orphaned requests
+so every accepted request still gets a reply — the zero-loss contract, chaos
+edition.  Asserts:
+
+* every client round-trip succeeds (the :class:`FleetClient` retry layer plus
+  the front's rerouting absorb the kill — zero lost replies);
+* the front's ``front_status.json`` reports ``rerouted > 0`` (the kill actually
+  exercised the reroute path, it didn't land between requests);
+* replies carry the fleet stamps (``replica`` + ``front_ms`` on top of the
+  replica's own SLO stamps).
+
+The workflow step then SIGTERMs the supervisor and asserts the front summary
+(accepted == replied, errors == 0) and that ``obs.top --once`` shows both
+replica slots — respawn included.
+
+Usage::
+
+    python benchmarks/fleet_smoke_clients.py <front_ready_file> <fleet_dir>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CLIENTS = 4
+REQUESTS_PER_CLIENT = 60
+REPLIES_BEFORE_KILL = 40
+
+
+def _wait_for_file(path: Path, timeout_s: float = 300.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if path.is_file():
+            try:
+                return json.loads(path.read_text())
+            except (json.JSONDecodeError, OSError):
+                pass  # mid-replace; retry
+        time.sleep(0.2)
+    raise TimeoutError(f"no readable JSON at {path} within {timeout_s}s")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    front_ready, fleet_dir = Path(argv[0]), Path(argv[1])
+
+    import numpy as np
+
+    from sheeprl_tpu.serve.client import FleetClient
+
+    port = _wait_for_file(front_ready)["port"]
+    endpoint = ("127.0.0.1", port)
+
+    # Replicas AOT-compile on boot: wait until the front sees >= 2 live
+    # non-canary replicas before starting the clock on the chaos scenario.
+    probe = FleetClient([endpoint], timeout_s=10.0)
+    deadline = time.monotonic() + 300.0
+    while True:
+        pong = probe.ping()
+        live = {
+            name: info
+            for name, info in (pong.get("fleet", {}).get("replicas") or {}).items()
+            if info.get("alive") and not info.get("canary")
+        }
+        if len(live) >= 2 and pong.get("policies"):
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"fleet never reached 2 live replicas: {pong}")
+        time.sleep(0.25)
+    policy = pong["policies"][0]
+
+    obs = {"state": np.zeros(4, dtype=np.float32)}  # jax_cartpole observation
+    replies = [0] * CLIENTS
+    stamps: list = []
+    errors: list = []
+
+    def worker(idx: int) -> None:
+        try:
+            with FleetClient([endpoint], timeout_s=60.0, session=f"smoke{idx}") as client:
+                for _ in range(REQUESTS_PER_CLIENT):
+                    _, meta = client.act(obs, policy, timeout=60)
+                    replies[idx] += 1
+                    stamps.append(meta)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True) for i in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    while sum(replies) < REPLIES_BEFORE_KILL:
+        if errors:
+            raise RuntimeError(f"client failed before the kill: {errors[0]}")
+        time.sleep(0.01)
+
+    # Pick a victim from the manager's replica records, preferring one the
+    # front currently has requests in flight on (so the kill provably orphans
+    # work), and SIGKILL it — no drain, no goodbye.
+    records_dir = fleet_dir / "replicas"
+    records = {}
+    for path in sorted(records_dir.glob("*.json")):
+        try:
+            rec = json.loads(path.read_text())
+        except (json.JSONDecodeError, OSError):
+            continue
+        if not rec.get("canary"):
+            records[rec["name"]] = rec
+    victim = None
+    kill_deadline = time.monotonic() + 10.0
+    while victim is None:
+        pong = probe.ping()
+        fleet = pong.get("fleet", {}).get("replicas") or {}
+        busy = [n for n, info in fleet.items() if n in records and info.get("inflight", 0) > 0]
+        if busy:
+            victim = records[busy[0]]
+        elif time.monotonic() > kill_deadline:
+            victim = next(iter(records.values()))  # kill *someone* mid-drive
+        else:
+            time.sleep(0.005)
+    os.kill(int(victim["pid"]), signal.SIGKILL)
+    print(f"fleet smoke: SIGKILLed replica {victim['name']} (pid {victim['pid']}) mid-flight")
+
+    for t in threads:
+        t.join(timeout=180)
+    if errors:
+        raise RuntimeError(f"client failed: {errors[0]}")
+    assert sum(replies) == CLIENTS * REQUESTS_PER_CLIENT, replies
+    probe.close()
+
+    for meta in stamps:
+        assert meta.get("replica"), meta  # the front stamps which replica served it
+        assert meta["front_ms"] >= 0, meta
+    served_by = sorted({meta["replica"] for meta in stamps})
+
+    # The reroute must have actually happened: the front's status file keeps
+    # the counter (status ticks every serve.fleet.status_interval_s).
+    status = None
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        try:
+            status = json.loads((fleet_dir / "front_status.json").read_text())
+        except (OSError, json.JSONDecodeError):
+            status = None
+        if status and status.get("rerouted", 0) > 0:
+            break
+        time.sleep(0.25)
+    assert status is not None, "front never wrote front_status.json"
+    assert status.get("rerouted", 0) > 0, f"kill did not exercise rerouting: {status}"
+
+    print(
+        f"fleet smoke: {sum(replies)} replies across {CLIENTS} clients, "
+        f"served by {served_by}, rerouted={status['rerouted']}, zero lost"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

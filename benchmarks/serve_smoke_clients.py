@@ -1,10 +1,16 @@
 """CI serve-smoke client driver (.github/workflows/cpu-tests.yaml "Serve smoke").
 
-Reads the replica's ready file, streams requests from 4 closed-loop client
-threads, asserts the SLO stamps are on every reply, then SIGTERMs the server
-PID *while requests are in flight* — each client ends on a ``draining`` reply
-or a closed channel, never a lost reply.  The workflow step then asserts the
-server exited 75 with ``accepted == replied`` in its summary.
+Reads the replica ready file(s), streams requests from 4 closed-loop
+:class:`~sheeprl_tpu.serve.client.FleetClient` threads, asserts the SLO stamps
+are on every reply, then SIGTERMs the server PID *while requests are in
+flight* — each client ends on the fleet client exhausting its bounded retries
+against the draining endpoint(s), never a lost reply.  The workflow step then
+asserts the server exited 75 with ``accepted == replied`` in its summary.
+
+The first argument accepts a comma-separated list of ready files: with more
+than one, every client fails over between the endpoints (the FleetClient
+rotates on ``draining``/dead-connection), so the same driver smokes a single
+replica or a hand-rolled multi-replica set.
 
 The optional third argument pins the replica's precision tier: the ready file
 must carry that ``precision`` and, for a non-f32 tier, a parity stamp vs the
@@ -12,7 +18,7 @@ f32 reference with >= 0.99 greedy action agreement (howto/precision.md).
 
 Usage::
 
-    python benchmarks/serve_smoke_clients.py <ready_file> <server_pid> [precision]
+    python benchmarks/serve_smoke_clients.py <ready_file[,ready_file...]> <server_pid> [precision]
 """
 
 from __future__ import annotations
@@ -34,29 +40,32 @@ REPLIES_BEFORE_SIGTERM = 100
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    ready_file, server_pid = Path(argv[0]), int(argv[1])
+    ready_files = [Path(p) for p in argv[0].split(",") if p]
+    server_pid = int(argv[1])
     expected_precision = argv[2] if len(argv) > 2 else None
 
     import numpy as np
 
-    from sheeprl_tpu.distributed.transport import ChannelClosed
-    from sheeprl_tpu.serve.client import PolicyClient, ServerDraining, wait_for_server
+    from sheeprl_tpu.serve.client import FleetClient, wait_for_server
 
-    deadline = time.monotonic() + 300.0
-    while not ready_file.is_file():
-        if time.monotonic() > deadline:
-            raise TimeoutError(f"no ready file at {ready_file}")
-        time.sleep(0.2)
-    ready = json.loads(ready_file.read_text())
-    port = ready["port"]
-    if expected_precision is not None:
-        assert ready["precision"] == expected_precision, ready
-        if expected_precision != "f32":
-            for name, stamp in ready["parity"].items():
-                assert stamp["reference"] == "f32", (name, stamp)
-                assert stamp["action_agreement"] >= 0.99, (name, stamp)
-            assert ready["parity"], "non-f32 replica published no parity stamp"
-    wait_for_server("127.0.0.1", port)
+    endpoints = []
+    for ready_file in ready_files:
+        deadline = time.monotonic() + 300.0
+        while not ready_file.is_file():
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"no ready file at {ready_file}")
+            time.sleep(0.2)
+        ready = json.loads(ready_file.read_text())
+        endpoints.append(("127.0.0.1", ready["port"]))
+        if expected_precision is not None:
+            assert ready["precision"] == expected_precision, ready
+            if expected_precision != "f32":
+                for name, stamp in ready["parity"].items():
+                    assert stamp["reference"] == "f32", (name, stamp)
+                    assert stamp["action_agreement"] >= 0.99, (name, stamp)
+                assert ready["parity"], "non-f32 replica published no parity stamp"
+    for host, port in endpoints:
+        wait_for_server(host, port)
 
     obs = {"state": np.zeros(4, dtype=np.float32)}  # jax_cartpole observation
     replies = [0] * CLIENTS
@@ -65,13 +74,15 @@ def main(argv=None) -> int:
 
     def worker(idx: int) -> None:
         try:
-            with PolicyClient("127.0.0.1", port) as client:
+            # Bounded retries: once every endpoint is draining/dead the act
+            # raises ConnectionError quickly instead of spinning forever.
+            with FleetClient(endpoints, max_attempts=4, backoff_max_s=0.5) as client:
                 while True:
                     _, meta = client.act(obs, "smoke_ppo", timeout=60)
                     replies[idx] += 1
                     stamps.append(meta)
-        except (ServerDraining, ChannelClosed, ConnectionError, TimeoutError, OSError):
-            pass  # the replica drained out from under us: a clean ending
+        except ConnectionError:
+            pass  # the replica(s) drained out from under us: a clean ending
         except Exception as e:  # noqa: BLE001 - surfaced below
             errors.append(e)
 
@@ -93,7 +104,8 @@ def main(argv=None) -> int:
         assert meta["p99_ms"] > 0, meta  # the rolling latency SLO stamp
         assert meta["bucket"] >= 1 and meta["infer_ms"] > 0, meta
     print(
-        f"serve smoke: {sum(replies)} replies across {CLIENTS} clients, "
+        f"serve smoke: {sum(replies)} replies across {CLIENTS} clients "
+        f"({len(endpoints)} endpoint(s)), "
         f"last p99={stamps[-1]['p99_ms']:.2f}ms bucket={stamps[-1]['bucket']}"
     )
     return 0

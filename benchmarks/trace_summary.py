@@ -137,7 +137,7 @@ def summarize_fleet(path: str) -> Dict[str, Any]:
         slot["gauges"] = {
             k: v for k, v in last.items() if not k.endswith("_per_s") and "/" in k
         }
-    order = {"learner": 0, "actor": 1, "serve": 2}
+    order = {"learner": 0, "actor": 1, "front": 2, "serve": 3}
     return {
         "timeline": path,
         "trace_id": trace_id,

@@ -32,7 +32,16 @@ Replicas are *stateless* — their checkpoints live in the model registry — so
 loop is simpler: no run-dir pinning, no resume-checkpoint discovery.  Exit 0
 (clean shutdown) → done; exit 75 (SIGTERM → drained everything accepted) →
 respawn immediately, bounded by ``fault.max_preemptions``; anything else →
-retry with the same bounded backoff as training.
+retry with the same bounded backoff as training.  Backoff scales with the
+*consecutive* crash count — a clean preemption in between proves the binary
+healthy and resets the clock — while ``fault.max_retries`` bounds total
+crashes over the supervisor's lifetime.  Every exit path (clean, budget
+exhausted, or the supervisor itself dying) writes a summary JSON to
+``fault.summary_path`` / ``SHEEPRL_TPU_SUPERVISE_SUMMARY``.
+
+Fleet mode: with ``serve.fleet.enabled=True`` the same entry point becomes the
+fleet manager (:func:`sheeprl_tpu.serve.fleet.manager.supervise_fleet`): front
++ N replicas, per-slot respawn, autoscaling, canary.
 """
 
 from __future__ import annotations
@@ -47,6 +56,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from sheeprl_tpu.fault import classify as _classify
 from sheeprl_tpu.fault.counters import RESTARTS_ENV_VAR
 from sheeprl_tpu.fault.preemption import RESUMABLE_EXIT_CODE
+
+#: Env var override for where the supervisor's exit summary lands.
+SUPERVISE_SUMMARY_ENV_VAR = "SHEEPRL_TPU_SUPERVISE_SUMMARY"
 
 
 def fault_cfg(cfg: Any) -> Dict[str, Any]:
@@ -108,12 +120,37 @@ def _log(msg: str) -> None:
     print(f"[supervise] {msg}", flush=True)
 
 
+def write_supervisor_summary(f_cfg: Dict[str, Any], doc: Dict[str, Any]) -> Optional[Path]:
+    """Atomically write the supervisor's lifetime summary.  Called from the exit
+    ``finally`` of every supervising loop — clean, budget-exhausted or crashed —
+    so post-mortems always find an account of what the supervisor saw."""
+    import json
+    import tempfile
+
+    path = os.environ.get(SUPERVISE_SUMMARY_ENV_VAR) or f_cfg.get("summary_path")
+    if not path:
+        return None
+    out = Path(str(path))
+    out.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp_name = tempfile.mkstemp(prefix=f".{out.name}.", suffix=".tmp", dir=out.parent)
+    with os.fdopen(fd, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp_name, out)
+    return out
+
+
 def supervise_serve(overrides: List[str]) -> int:
     """The serving-mode relaunch loop: keep one stateless replica alive.
 
     A drained preemption (rc 75) means every accepted request was answered
     before exit — the respawn is immediate because a replica that is down is
-    pure lost capacity.  Crashes back off exactly like training retries.
+    pure lost capacity.  Crashes back off on the *consecutive*-crash count
+    (reset by a clean preemption: a replica that drained correctly is healthy,
+    the next crash is a fresh incident, not an escalation), while
+    ``fault.max_retries`` still bounds total crashes.
+
+    With ``serve.fleet.enabled=True`` this becomes the fleet manager instead:
+    front + N replicas, autoscaling, canary (``serve/fleet/manager.py``).
     """
     from sheeprl_tpu.config.core import compose
     from sheeprl_tpu.obs.fleet import (
@@ -124,6 +161,10 @@ def supervise_serve(overrides: List[str]) -> int:
     )
 
     cfg = compose(config_name="serve_cli", overrides=overrides)
+    if bool(((cfg.get("serve") or {}).get("fleet") or {}).get("enabled", False)):
+        from sheeprl_tpu.serve.fleet.manager import supervise_fleet
+
+        return supervise_fleet(overrides, cfg=cfg)
     f_cfg = fault_cfg(cfg)
     max_retries = int(f_cfg.get("max_retries", 3))
     max_preemptions = f_cfg.get("max_preemptions")  # None = respawn preemptions forever
@@ -148,8 +189,24 @@ def supervise_serve(overrides: List[str]) -> int:
         except OSError as e:
             _log(f"fleet telemetry disabled: {e}")
 
-    retries = 0
+    retries = 0  # total crashes, bounded by fault.max_retries
     preemptions = 0
+    consecutive_crashes = 0  # backoff input; a clean preemption resets it
+    summary: Dict[str, Any] = {
+        "mode": "serve",
+        "attempts": 0,
+        "retries": 0,
+        "preemptions": 0,
+        "events": [],
+        "outcome": None,
+        "rc": None,
+    }
+
+    def _finish(outcome: str, rc: int) -> int:
+        summary["outcome"] = outcome
+        summary["rc"] = rc
+        return rc
+
     try:
         while True:
             env = dict(os.environ)
@@ -158,6 +215,7 @@ def supervise_serve(overrides: List[str]) -> int:
             env.pop(FLEET_ENV_VAR, None)
             if fleet is not None:
                 env[FLEET_ENV_VAR] = fleet.address
+            summary["attempts"] += 1
             _log(
                 f"serve attempt {retries + preemptions + 1} "
                 f"(retries={retries}/{max_retries}, preemptions={preemptions})"
@@ -166,15 +224,21 @@ def supervise_serve(overrides: List[str]) -> int:
             rc = proc.returncode
             if rc == 0:
                 _log("replica shut down cleanly")
-                return 0
+                return _finish("clean", 0)
             if rc == RESUMABLE_EXIT_CODE:
                 preemptions += 1
+                consecutive_crashes = 0  # a correct drain proves the binary healthy
+                summary["preemptions"] = preemptions
+                summary["events"].append({"kind": "preemption", "rc": rc, "time": time.time()})
                 if max_preemptions is not None and preemptions > int(max_preemptions):
                     _log(f"exceeded fault.max_preemptions={max_preemptions}; giving up")
-                    return rc
+                    return _finish("preemption_budget", rc)
                 _log(f"replica drained on preemption (rc={rc}); respawning immediately")
                 continue
             retries += 1
+            consecutive_crashes += 1
+            summary["retries"] = retries
+            summary["events"].append({"kind": "crash", "rc": rc, "time": time.time()})
             if fleet is not None:
                 try:
                     bundle = fleet.collect_blackboxes(f"serve_rc{rc}")
@@ -184,11 +248,19 @@ def supervise_serve(overrides: List[str]) -> int:
                     _log(f"fleet blackbox collection failed: {e}")
             if retries > max_retries:
                 _log(f"exceeded fault.max_retries={max_retries}; giving up (rc={rc})")
-                return rc if rc else 1
-            delay = backoff_seconds(retries, base_backoff, max_backoff)
-            _log(f"replica died (rc={rc}); retry {retries}/{max_retries} in {delay:.1f}s")
+                return _finish("retry_budget", rc if rc else 1)
+            delay = backoff_seconds(consecutive_crashes, base_backoff, max_backoff)
+            _log(
+                f"replica died (rc={rc}); retry {retries}/{max_retries} "
+                f"(consecutive crash {consecutive_crashes}) in {delay:.1f}s"
+            )
             time.sleep(delay)
+    except BaseException:
+        if summary["outcome"] is None:
+            summary["outcome"] = "supervisor_crashed"
+        raise
     finally:
+        write_supervisor_summary(f_cfg, summary)
         if fleet is not None:
             fleet.close()
 

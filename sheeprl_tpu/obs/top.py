@@ -115,7 +115,7 @@ def format_top(snapshot: Dict[str, Any], now: Optional[float] = None) -> str:
     lines.append(header)
     lines.append("-" * len(header))
     procs = snapshot.get("processes") or {}
-    for key in sorted(procs, key=lambda k: ({"learner": 0, "actor": 1, "serve": 2}.get(procs[k].get("role"), 9), k)):
+    for key in sorted(procs, key=lambda k: ({"learner": 0, "actor": 1, "front": 2, "serve": 3}.get(procs[k].get("role"), 9), k)):
         proc = procs[key]
         metrics = proc.get("metrics") or {}
         wall = proc.get("wall_clock")
@@ -130,15 +130,58 @@ def format_top(snapshot: Dict[str, Any], now: Optional[float] = None) -> str:
             _fmt(age_s, _COLUMNS[5][1]),
             _fmt(_first(metrics, "grad_steps_per_s"), _COLUMNS[6][1]),
             _fmt(_first(metrics, "env_steps_per_s"), _COLUMNS[7][1]),
-            _fmt(_first(metrics, "Sebulba/queue_depth", "Serve/queue_depth"), _COLUMNS[8][1], 0),
+            _fmt(
+                _first(metrics, "Sebulba/queue_depth", "Serve/queue_depth", "Fleet/pending"),
+                _COLUMNS[8][1],
+                0,
+            ),
             _fmt(_first(metrics, "Sebulba/param_staleness_steps"), _COLUMNS[9][1], 0),
             str(proc.get("respawns", "-")).rjust(_COLUMNS[10][1]),
             _fmt(None if slo_burn is None else slo_burn * 100.0, _COLUMNS[11][1]),
-            _fmt(_first(metrics, "Serve/latency_p99_ms"), _COLUMNS[12][1]),
+            _fmt(
+                _first(metrics, "Serve/latency_p99_ms", "Fleet/latency_p99_ms"),
+                _COLUMNS[12][1],
+            ),
         ]
         lines.append(" ".join(cells))
     if not procs:
         lines.append("(no processes reported yet)")
+    # Fleet-front detail: routed share per replica, reroutes, scale history,
+    # canary agreement — the router's own gauges, one line per front slot.
+    for key in sorted(procs):
+        proc = procs[key]
+        if proc.get("role") != "front":
+            continue
+        metrics = proc.get("metrics") or {}
+        shares = {
+            name.split("/", 2)[2]: value
+            for name, value in metrics.items()
+            if isinstance(name, str) and name.startswith("Fleet/share/")
+        }
+        bits = [f"front {key}:"]
+        if shares:
+            bits.append(
+                "share["
+                + " ".join(
+                    f"{replica}={float(share) * 100.0:.0f}%"
+                    for replica, share in sorted(shares.items())
+                )
+                + "]"
+            )
+        reroutes = _first(metrics, "Fleet/reroutes")
+        if reroutes is not None:
+            bits.append(f"reroutes={reroutes:.0f}")
+        admitted = _first(metrics, "Fleet/replicas_admitted")
+        retired = _first(metrics, "Fleet/replicas_retired")
+        if admitted is not None or retired is not None:
+            bits.append(f"replicas +{admitted or 0:.0f}/-{retired or 0:.0f}")
+        live = _first(metrics, "Fleet/live_replicas")
+        if live is not None:
+            bits.append(f"live={live:.0f}")
+        agreement = _first(metrics, "Fleet/canary_agreement")
+        if agreement is not None:
+            bits.append(f"canary_agreement={agreement:.3f}")
+        lines.append(" ".join(bits))
     return "\n".join(lines)
 
 

@@ -1,4 +1,4 @@
-"""Synchronous serve client: one obs in, one action (plus latency stamps) out.
+"""Synchronous serve clients: one obs in, one action (plus latency stamps) out.
 
 A :class:`PolicyClient` wraps one framed-TCP channel and does strict
 request/reply round-trips — concurrency is *many clients*, not pipelining on
@@ -6,6 +6,13 @@ one socket (the transport's ``recv`` is single-consumer).  The benchmark and
 the CI smoke drive 4-32 of these from threads; a production fleet would run
 one per actor process, exactly like the Sebulba actors drive their learner
 channel.
+
+A :class:`FleetClient` adds the availability layer: it takes *several*
+endpoints (fleet fronts or bare replicas), fails over between them, and
+retries ``draining`` / dead-connection failures with bounded exponential
+backoff — the client-side half of the zero-loss contract.  Stateful policies
+pass ``session=<client id>`` so the fleet keeps their recurrent act state on
+one replica.
 """
 
 from __future__ import annotations
@@ -13,11 +20,11 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from sheeprl_tpu.distributed.transport import Channel, connect
+from sheeprl_tpu.distributed.transport import Channel, ChannelClosed, connect
 
 _REQ_COUNTER = itertools.count()
 _REQ_LOCK = threading.Lock()
@@ -49,15 +56,24 @@ class PolicyClient:
         obs: Dict[str, np.ndarray],
         policy: str,
         timeout: float = 30.0,
+        session: Optional[str] = None,
+        reset: bool = False,
     ) -> Tuple[np.ndarray, Dict[str, Any]]:
         """One round-trip: ``(action_row, reply_meta)``.
 
         ``reply_meta`` carries the SLO stamps: ``queue_ms`` / ``infer_ms`` /
         ``batch_fill`` / ``bucket`` / ``p99_ms`` (the server's rolling p99 at
-        reply time).
+        reply time).  ``session`` names this client for stateful (recurrent)
+        policies — the serve tier keeps the session's act state device-resident
+        between calls; ``reset=True`` forces an episode restart for it.
         """
         req_id = _next_req_id()
-        self.channel.send("act", payload=dict(obs), policy=policy, req_id=req_id)
+        extra: Dict[str, Any] = {}
+        if session is not None:
+            extra["session"] = session
+        if reset:
+            extra["reset"] = True
+        self.channel.send("act", payload=dict(obs), policy=policy, req_id=req_id, **extra)
         kind, meta, payload = self.channel.recv(timeout=timeout)
         if kind == "draining":
             raise ServerDraining(f"request {req_id} rejected: replica is draining")
@@ -71,6 +87,124 @@ class PolicyClient:
         self.channel.close()
 
     def __enter__(self) -> "PolicyClient":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def parse_endpoint(endpoint: Union[str, Tuple[str, int]]) -> Tuple[str, int]:
+    """``"host:port"`` (or a ready ``(host, port)`` pair) → ``(host, port)``."""
+    if isinstance(endpoint, (tuple, list)):
+        host, port = endpoint
+        return str(host), int(port)
+    host, _, port = str(endpoint).rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class FleetClient:
+    """Failover + retry over several serve endpoints (fronts or bare replicas).
+
+    Each :meth:`act` keeps one endpoint until it fails: ``draining`` replies,
+    dead connections and connect failures rotate to the next endpoint and retry
+    after a bounded exponential backoff (``backoff_s`` doubling per consecutive
+    failure up to ``backoff_max_s``, at most ``max_attempts`` tries per call).
+    Server-side ``error`` replies are NOT retried — they are deterministic
+    (unknown policy, malformed obs) and would fail everywhere.
+
+    ``session`` (constructor or per-call) tags requests for stateful policies;
+    note that failing over to a *different* endpoint restarts the session's
+    episode on the new fleet (the state lives server-side).
+    """
+
+    def __init__(
+        self,
+        endpoints: Sequence[Union[str, Tuple[str, int]]],
+        timeout_s: float = 30.0,
+        max_attempts: int = 8,
+        backoff_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        session: Optional[str] = None,
+    ):
+        if not endpoints:
+            raise ValueError("FleetClient needs at least one endpoint")
+        self.endpoints: List[Tuple[str, int]] = [parse_endpoint(e) for e in endpoints]
+        self.timeout_s = float(timeout_s)
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.session = session
+        self._index = 0  # current endpoint
+        self._client: Optional[PolicyClient] = None
+        self.failovers = 0
+        self.retries = 0
+
+    def _connected(self) -> PolicyClient:
+        if self._client is None:
+            host, port = self.endpoints[self._index]
+            self._client = PolicyClient(host, port, timeout_s=self.timeout_s)
+        return self._client
+
+    def _rotate(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+            self._client = None
+        self._index = (self._index + 1) % len(self.endpoints)
+        self.failovers += 1
+
+    def act(
+        self,
+        obs: Dict[str, np.ndarray],
+        policy: str,
+        timeout: Optional[float] = None,
+        session: Optional[str] = None,
+        reset: bool = False,
+    ) -> Tuple[np.ndarray, Dict[str, Any]]:
+        timeout = self.timeout_s if timeout is None else float(timeout)
+        session = session if session is not None else self.session
+        consecutive = 0
+        last: Optional[Exception] = None
+        for _ in range(self.max_attempts):
+            try:
+                return self._connected().act(
+                    obs, policy, timeout=timeout, session=session, reset=reset
+                )
+            except (ServerDraining, ChannelClosed, ConnectionError, OSError, TimeoutError) as e:
+                last = e
+                consecutive += 1
+                self.retries += 1
+                self._rotate()
+                time.sleep(min(self.backoff_s * (2 ** (consecutive - 1)), self.backoff_max_s))
+        raise ConnectionError(
+            f"act failed after {self.max_attempts} attempts across "
+            f"{len(self.endpoints)} endpoint(s): {last}"
+        )
+
+    def ping(self, timeout: float = 10.0) -> Dict[str, Any]:
+        consecutive = 0
+        last: Optional[Exception] = None
+        for _ in range(self.max_attempts):
+            try:
+                return self._connected().ping(timeout=timeout)
+            except (ChannelClosed, ConnectionError, OSError, TimeoutError) as e:
+                last = e
+                consecutive += 1
+                self._rotate()
+                time.sleep(min(self.backoff_s * (2 ** (consecutive - 1)), self.backoff_max_s))
+        raise ConnectionError(
+            f"ping failed after {self.max_attempts} attempts across "
+            f"{len(self.endpoints)} endpoint(s): {last}"
+        )
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def __enter__(self) -> "FleetClient":
         return self
 
     def __exit__(self, *exc: Any) -> None:

@@ -1,0 +1,26 @@
+"""Serving fleet: a load-balanced front over N policy-server replicas.
+
+The front (``python -m sheeprl_tpu.serve.fleet``) speaks the PR-13 framed
+transport on both sides: clients talk to it exactly like they talk to one
+replica (same ``ping``/``act`` grammar), and it fans requests out to the
+least-loaded live replica, rerouting on drain/death with zero accepted-request
+loss.  The fleet manager (:mod:`sheeprl_tpu.serve.fleet.manager`, reached via
+``python -m sheeprl_tpu.supervise --serve`` with ``serve.fleet.enabled=True``)
+spawns the front plus ``serve.fleet.min_replicas`` replicas, respawns the dead,
+and autoscales between ``min`` and ``max`` on sustained load.
+
+Pure decision logic lives in its own modules so tests hit it without sockets:
+
+* :mod:`~sheeprl_tpu.serve.fleet.routing` — least-loaded selection + the
+  consistent-hash ring for session affinity;
+* :mod:`~sheeprl_tpu.serve.fleet.autoscale` — the hysteresis scale-up/-down
+  decider;
+* :mod:`~sheeprl_tpu.serve.fleet.canary` — live greedy-agreement accounting
+  for canary deployments (PR-15 ``precision.parity`` reused).
+"""
+
+from sheeprl_tpu.serve.fleet.autoscale import AutoscaleDecider
+from sheeprl_tpu.serve.fleet.canary import CanaryTracker
+from sheeprl_tpu.serve.fleet.routing import HashRing, ReplicaLoad, pick_replica
+
+__all__ = ["AutoscaleDecider", "CanaryTracker", "HashRing", "ReplicaLoad", "pick_replica"]

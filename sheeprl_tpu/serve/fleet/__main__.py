@@ -1,0 +1,42 @@
+"""``python -m sheeprl_tpu.serve.fleet`` — run the fleet front (router).
+
+The front is a pure routing process: it composes the same ``serve_cli`` config
+as a replica (so ``serve.fleet.*`` overrides use one grammar), but it never
+imports JAX and never touches the compile cache — replicas own the
+accelerator; the front owns the door.
+
+Typically spawned by the fleet manager (``python -m sheeprl_tpu.supervise
+--serve serve.fleet.enabled=True``); standalone use with a static replica
+list::
+
+    python -m sheeprl_tpu.serve.fleet \\
+        serve.fleet.replicas='[127.0.0.1:7557,127.0.0.1:7558]' \\
+        serve.fleet.port=7550
+
+Exits 75 (``RESUMABLE_EXIT_CODE``) after a SIGTERM drain so the manager
+respawns it like any replica.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    overrides = list(sys.argv[1:] if argv is None else argv)
+    from sheeprl_tpu.config.core import compose
+
+    cfg = compose(config_name="serve_cli", overrides=overrides)
+
+    from sheeprl_tpu.fault.preemption import install_signal_handlers
+
+    install_signal_handlers()
+
+    from sheeprl_tpu.serve.fleet.front import FleetFront
+
+    return FleetFront(cfg).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""The autoscaler's decision function: sustained load up, sustained idle down.
+
+Deliberately a pure state machine over ``(now, live_replicas, load)`` samples so
+the no-flapping contract is unit-testable without processes:
+
+* **scale up** when the mean in-flight-per-replica load has been at or above
+  ``scale_up_queue_depth`` for ``scale_up_after_s`` continuously and the fleet
+  is below ``max_replicas``;
+* **scale down** when the fleet has been completely idle (zero pending) for
+  ``scale_down_after_s`` continuously and the fleet is above ``min_replicas``;
+* **hysteresis**: any load strictly between zero and the up-threshold resets
+  BOTH clocks (the dead zone — a fleet hovering around the threshold neither
+  grows nor shrinks), and every decision starts a ``cooldown_s`` window during
+  which no further decision fires (a fresh replica needs time to absorb load
+  before the sample means anything).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class AutoscaleDecider:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_queue_depth: float = 4.0  # mean pending per live replica
+    scale_up_after_s: float = 3.0
+    scale_down_after_s: float = 10.0
+    cooldown_s: float = 5.0
+
+    _hot_since: Optional[float] = field(default=None, repr=False)
+    _idle_since: Optional[float] = field(default=None, repr=False)
+    _last_decision: float = field(default=float("-inf"), repr=False)
+
+    def decide(self, now: float, live: int, pending: float) -> Optional[str]:
+        """One sample → ``"up"``, ``"down"`` or ``None``.
+
+        ``live`` is the current routable replica count, ``pending`` the fleet's
+        total outstanding requests (front in-flight + replica queues).
+        """
+        load = pending / max(live, 1)
+        if load >= self.scale_up_queue_depth:
+            self._idle_since = None
+            if self._hot_since is None:
+                self._hot_since = now
+        elif pending <= 0:
+            self._hot_since = None
+            if self._idle_since is None:
+                self._idle_since = now
+        else:  # the dead zone: partial load is a reason to do nothing
+            self._hot_since = None
+            self._idle_since = None
+
+        if now - self._last_decision < self.cooldown_s:
+            return None
+        if (
+            self._hot_since is not None
+            and now - self._hot_since >= self.scale_up_after_s
+            and live < self.max_replicas
+        ):
+            self._last_decision = now
+            self._hot_since = None
+            return "up"
+        if (
+            self._idle_since is not None
+            and now - self._idle_since >= self.scale_down_after_s
+            and live > self.min_replicas
+        ):
+            self._last_decision = now
+            self._idle_since = None
+            return "down"
+        return None

@@ -1,0 +1,105 @@
+"""Canary deployment accounting: live greedy agreement vs the incumbent.
+
+``serve.fleet.canary={spec, fraction}`` makes the front route ``fraction`` of
+the eligible live traffic to a replica serving the candidate registry version.
+Every canary-routed request is *shadowed*: the same observation also goes to an
+incumbent replica, the client gets the canary's answer (it is live traffic, not
+a dark launch), and the two greedy actions are compared.  The running agreement
+is stamped into the front's summary as the promotion gate:
+``promote = compared > 0 and agreement >= min_agreement``.
+
+The agreement metric is PR-15's parity contract
+(:func:`sheeprl_tpu.precision.parity.action_agreement`): discrete actions must
+match exactly, continuous actions agree when every component is within
+``atol``.  It is re-implemented here on plain numpy — importing
+``precision.parity`` would pull JAX into the router process, which must never
+initialize an accelerator — and ``tests/test_serve/test_fleet_routing.py`` pins
+the two implementations against each other on random batches.
+
+Routing uses an error-diffusion accumulator rather than randomness, so exactly
+``round(n * fraction)`` of n eligible requests hit the canary — deterministic
+fractions make the CI assertion exact.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def rows_agree(a: np.ndarray, b: np.ndarray, atol: float = 1e-2) -> bool:
+    """One action row each: exact match for integer (discrete) actions,
+    per-component ``atol`` for floats — ``parity.action_agreement`` on a
+    batch of one."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.shape != b.shape:
+        return False
+    if np.issubdtype(a.dtype, np.floating) or np.issubdtype(b.dtype, np.floating):
+        return bool(np.all(np.abs(a.astype(np.float64) - b.astype(np.float64)) <= atol))
+    return bool(np.array_equal(a, b))
+
+
+class CanaryTracker:
+    """Thread-safe canary routing + agreement ledger (the front's replica
+    readers record from their own threads)."""
+
+    def __init__(self, spec: str, fraction: float, min_agreement: float = 0.99, atol: float = 1e-2):
+        self.spec = str(spec)
+        self.fraction = float(fraction)
+        self.min_agreement = float(min_agreement)
+        self.atol = float(atol)
+        self.routed = 0
+        self.compared = 0
+        self.agreed = 0
+        self._acc = 0.0
+        self._lock = threading.Lock()
+
+    def take(self) -> bool:
+        """Should the next eligible request go to the canary?  Error-diffusion:
+        the accumulator gains ``fraction`` per eligible request and a unit is
+        spent per canary route."""
+        if self.fraction <= 0.0:
+            return False
+        with self._lock:
+            self._acc += self.fraction
+            if self._acc >= 1.0:
+                self._acc -= 1.0
+                self.routed += 1
+                return True
+        return False
+
+    def record(self, incumbent_action: Any, canary_action: Any) -> None:
+        agree = rows_agree(incumbent_action, canary_action, atol=self.atol)
+        with self._lock:
+            self.compared += 1
+            if agree:
+                self.agreed += 1
+
+    @property
+    def agreement(self) -> float:
+        with self._lock:
+            return self.agreed / self.compared if self.compared else math.nan
+
+    @property
+    def promote(self) -> bool:
+        with self._lock:
+            compared, agreed = self.compared, self.agreed
+        return compared > 0 and agreed / compared >= self.min_agreement
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            compared, agreed, routed = self.compared, self.agreed, self.routed
+        agreement: Optional[float] = agreed / compared if compared else None
+        return {
+            "spec": self.spec,
+            "fraction": self.fraction,
+            "min_agreement": self.min_agreement,
+            "routed": routed,
+            "compared": compared,
+            "agreement": agreement,
+            "promote": compared > 0 and agreement is not None and agreement >= self.min_agreement,
+        }

@@ -1,0 +1,795 @@
+"""The fleet front: one framed-TCP door, N policy-server replicas behind it.
+
+Clients speak to the front exactly as they would to a single replica (same
+``ping``/``act`` wire grammar, ``serve/server.py``), so :class:`PolicyClient`
+and :class:`FleetClient` work unchanged.  Internally the front keeps one
+upstream channel + reader thread per replica and an in-flight ledger per link:
+
+* each ``act`` is re-stamped with a front-local request id, routed to the
+  least-loaded live replica (``routing.pick_replica`` over the front's own
+  in-flight counts + the queue depth/p99 the replicas report via pong probes
+  and the PR-16 fleet telemetry snapshot), and the reply is forwarded to the
+  client under its original id with a ``replica`` stamp added;
+* a ``draining`` reply (the PR-14 drain contract) marks the link draining and
+  instantly reroutes the request — clients never see the drain;
+* a dead channel retires the link and resubmits every request it still owed —
+  zero accepted-request loss as long as any replica lives (otherwise requests
+  park and retry on re-admission, bounded by ``serve.fleet.park_timeout_s``);
+* sessions (``act`` meta ``session=...``, the stateful-policy client id) route
+  by consistent hash (``routing.HashRing``) so a recurrent policy's
+  device-resident state stays on one replica; a replica death reassigns only
+  its sessions (their recurrent state restarts — the server treats an unknown
+  session as an episode start);
+* ``serve.fleet.canary`` routes a deterministic fraction of the session-less
+  traffic to the canary replica and shadows each such request to an incumbent,
+  feeding :class:`~sheeprl_tpu.serve.fleet.canary.CanaryTracker` — the live
+  agreement stamp in the front's summary.
+
+Replicas are discovered from ``serve.fleet.replicas`` (static ``host:port``
+list) and from the record files the fleet manager drops in
+``<serve.fleet.dir>/replicas/`` as replicas come ready (respawns rewrite the
+record with the new port/generation).  The front writes
+``<serve.fleet.dir>/front_status.json`` every ``status_interval_s`` — the
+manager's autoscaler input — and exports ``role="front"`` telemetry rows.
+
+No JAX anywhere in this process: the front is pure routing and must never
+initialize an accelerator.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.distributed.transport import Channel, ChannelClosed, FramingError, Listener, connect
+from sheeprl_tpu.fault import preemption as fault_preemption
+from sheeprl_tpu.obs.fleet import maybe_exporter
+from sheeprl_tpu.serve.fleet.canary import CanaryTracker
+from sheeprl_tpu.serve.fleet.routing import HashRing, ReplicaLoad, pick_replica, routable
+from sheeprl_tpu.utils.metric import MetricAggregator
+
+#: Env var override for where the front's exit summary lands (CI / chaos harness).
+FRONT_SUMMARY_ENV_VAR = "SHEEPRL_TPU_FLEET_SUMMARY"
+
+#: Replica record files the manager writes; the front polls them for admission.
+RECORDS_SUBDIR = "replicas"
+
+#: Connect budget when admitting a replica.  Kept short — and discovery runs off
+#: the accept loop — so one dead endpoint can never stall live traffic.
+CONNECT_TIMEOUT_S = 2.0
+
+#: After a failed admission, leave the endpoint alone this long before retrying.
+ADMIT_RETRY_S = 2.0
+
+
+class _CanaryPair:
+    """One canary-routed request and its incumbent shadow; completes when both
+    actions arrived (a dead half just drops the comparison)."""
+
+    __slots__ = ("lock", "actions")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.actions: Dict[str, np.ndarray] = {}
+
+
+@dataclass
+class _FrontRequest:
+    channel: Optional[Channel]  # client channel; None for a canary shadow
+    req_id: Any  # the client's id (front ids are internal)
+    policy: str
+    obs: Any
+    session: Optional[str]
+    reset: bool
+    t_enq: float
+    attempts: int = 0
+    pair: Optional[_CanaryPair] = None
+    pair_role: Optional[str] = None  # "canary" | "incumbent"
+
+
+class ReplicaLink:
+    """One upstream replica: channel, reader thread, in-flight ledger, load."""
+
+    def __init__(self, front: "FleetFront", name: str, host: str, port: int,
+                 canary: bool = False, generation: int = 0, pid: Optional[int] = None):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.canary = bool(canary)
+        self.generation = int(generation)
+        self.pid = pid
+        self.channel: Channel = connect(host, int(port), timeout_s=CONNECT_TIMEOUT_S)
+        self.pending: Dict[int, _FrontRequest] = {}
+        self.load = ReplicaLoad()
+        self.routed = 0  # lifetime requests this link carried (share accounting)
+        self.retired = False
+        self.reader = threading.Thread(
+            target=front._replica_reader, args=(self,), name=f"fleet-replica-{name}", daemon=True
+        )
+        self.reader.start()
+
+
+class FleetFront:
+    """Route → reroute → summarize.  One instance per front process."""
+
+    def __init__(self, cfg: Any):
+        self.cfg = cfg
+        serve_cfg = cfg.serve
+        fleet_cfg = serve_cfg.fleet
+        self.fleet_cfg = fleet_cfg
+        self.drain_timeout_s = float(serve_cfg.drain_timeout_s)
+        self.probe_interval_s = float(fleet_cfg.probe_interval_s)
+        self.status_interval_s = float(fleet_cfg.status_interval_s)
+        self.max_route_attempts = int(fleet_cfg.max_route_attempts)
+        self.park_timeout_s = float(fleet_cfg.park_timeout_s)
+        self.affinity = bool(fleet_cfg.affinity)
+        self.fleet_dir: Optional[Path] = Path(str(fleet_cfg.dir)) if fleet_cfg.dir else None
+        records = fleet_cfg.get("replicas_dir") or (
+            self.fleet_dir / RECORDS_SUBDIR if self.fleet_dir else None
+        )
+        self.records_dir: Optional[Path] = Path(str(records)) if records else None
+        self.static_endpoints: List[str] = [str(e) for e in (fleet_cfg.replicas or [])]
+
+        canary_cfg = fleet_cfg.get("canary") or {}
+        spec = canary_cfg.get("spec")
+        self.canary: Optional[CanaryTracker] = (
+            CanaryTracker(
+                str(spec),
+                float(canary_cfg.get("fraction", 0.0)),
+                min_agreement=float(canary_cfg.get("min_agreement", 0.99)),
+            )
+            if spec
+            else None
+        )
+
+        self._fid = itertools.count(1)
+        self._lock = threading.Lock()
+        self.replicas: Dict[str, ReplicaLink] = {}
+        self.ring = HashRing()
+        self._parked: Deque[Tuple[_FrontRequest, float]] = deque()
+        self._policies: set = set()
+        self._draining = False
+        self._stop = threading.Event()
+        self._channels: List[Channel] = []
+        self.listener: Optional[Listener] = None
+        self._fleet = None  # FleetExporter
+
+        # Counters (under self._lock unless noted).
+        self.accepted = 0
+        self.replied = 0
+        self.rerouted = 0
+        self.errors = 0
+        self.dropped = 0  # replies whose client channel was gone
+        self.rejected_draining = 0
+        self.parked_expired = 0
+        self.replicas_admitted = 0
+        self.replicas_retired = 0
+        self.metrics = MetricAggregator({"Fleet/latency_ms": "histogram"})
+        self._admit_after: Dict[str, float] = {}  # name -> earliest retry (monotonic)
+        self._discover_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------- admit
+    def _admit(self, name: str, host: str, port: int, canary: bool = False,
+               generation: int = 0, pid: Optional[int] = None) -> bool:
+        endpoint = f"{host}:{port}"  # a respawn at a new port retries immediately
+        if time.monotonic() < self._admit_after.get(endpoint, 0.0):
+            return False  # recently failed to connect; don't hammer it
+        try:
+            link = ReplicaLink(self, name, host, port, canary=canary, generation=generation, pid=pid)
+        except (ConnectionError, OSError, TimeoutError):
+            self._admit_after[endpoint] = time.monotonic() + ADMIT_RETRY_S
+            return False  # not up yet; a later discovery tick retries
+        self._admit_after.pop(endpoint, None)
+        with self._lock:
+            self.replicas[name] = link
+            self.replicas_admitted += 1
+            if not canary:
+                self.ring.add(name)
+        self._log(f"admitted replica {name} at {host}:{port} (gen {generation})")
+        try:
+            link.channel.send("ping")
+        except (ChannelClosed, OSError):
+            pass
+        self._retry_parked()
+        return True
+
+    def _retire(self, link: ReplicaLink, resubmit: bool = True) -> None:
+        with self._lock:
+            if link.retired:
+                return
+            link.retired = True
+            link.load.alive = False
+            if self.replicas.get(link.name) is link:
+                del self.replicas[link.name]
+            self.ring.remove(link.name)
+            self.replicas_retired += 1
+            owed = list(link.pending.values())
+            link.pending.clear()
+        try:
+            link.channel.close()
+        except Exception:
+            pass
+        if owed:
+            self._log(f"replica {link.name} gone with {len(owed)} in flight; rerouting")
+        for req in owed:
+            with self._lock:
+                self.rerouted += 1
+            if resubmit:
+                self._resubmit(req)
+
+    def _discover(self) -> None:
+        for i, endpoint in enumerate(self.static_endpoints):
+            name = f"static{i}"
+            canary = endpoint.startswith("canary@")
+            hostport = endpoint.split("@", 1)[-1]
+            host, _, port = hostport.rpartition(":")
+            with self._lock:
+                known = name in self.replicas
+            if not known:
+                self._admit(name, host or "127.0.0.1", int(port), canary=canary)
+        if self.records_dir is None or not self.records_dir.is_dir():
+            return
+        for path in sorted(self.records_dir.glob("*.json")):
+            try:
+                rec = json.loads(path.read_text())
+            except (OSError, ValueError):
+                continue
+            name = str(rec.get("name", path.stem))
+            with self._lock:
+                existing = self.replicas.get(name)
+            if existing is not None:
+                same = (existing.host, existing.port) == (rec.get("host"), int(rec.get("port", 0)))
+                if same or not existing.retired:
+                    continue  # live link, or the respawn's record already admitted
+            self._admit(
+                name,
+                str(rec.get("host", "127.0.0.1")),
+                int(rec.get("port", 0)),
+                canary=bool(rec.get("canary")),
+                generation=int(rec.get("generation", 0)),
+                pid=rec.get("pid"),
+            )
+
+    # ------------------------------------------------------------------ routing
+    def _loads(self) -> Dict[str, ReplicaLoad]:
+        """Locked caller: the live load picture, in-flight from the ledger."""
+        out: Dict[str, ReplicaLoad] = {}
+        for name, link in self.replicas.items():
+            load = link.load
+            load.inflight = len(link.pending)
+            out[name] = load
+        return out
+
+    def _canary_link(self) -> Optional[ReplicaLink]:
+        with self._lock:
+            for link in self.replicas.values():
+                if link.canary and routable(link.load):
+                    return link
+        return None
+
+    def _route_new(self, req: _FrontRequest) -> None:
+        """First routing of a freshly-accepted request: canary split, then the
+        normal least-loaded/affinity path."""
+        if self.canary is not None and req.session is None:
+            canary_link = self._canary_link()
+            if canary_link is not None and self.canary.take():
+                pair = _CanaryPair()
+                req.pair, req.pair_role = pair, "canary"
+                shadow = _FrontRequest(
+                    channel=None, req_id=None, policy=req.policy, obs=req.obs,
+                    session=None, reset=req.reset, t_enq=req.t_enq,
+                    pair=pair, pair_role="incumbent",
+                )
+                self._send_to(canary_link, req)
+                self._submit(shadow, exclude=(canary_link.name,))
+                return
+        self._submit(req)
+
+    def _submit(self, req: _FrontRequest, exclude: Tuple[str, ...] = ()) -> None:
+        target: Optional[ReplicaLink] = None
+        with self._lock:
+            exclude = exclude + tuple(n for n, l in self.replicas.items() if l.canary)
+            if req.session is not None and self.affinity:
+                owner = self.ring.assign(req.session)
+                link = self.replicas.get(owner) if owner else None
+                if link is not None and owner not in exclude and routable(link.load):
+                    target = link
+            if target is None:
+                name = pick_replica(self._loads(), exclude=exclude)
+                target = self.replicas.get(name) if name else None
+        if target is None:
+            self._park(req)
+            return
+        self._send_to(target, req)
+
+    def _send_to(self, link: ReplicaLink, req: _FrontRequest) -> None:
+        fid = next(self._fid)
+        with self._lock:
+            if link.retired:
+                pass  # fall through to the failure path below via a closed send
+            link.pending[fid] = req
+            link.routed += 1
+        meta: Dict[str, Any] = {"policy": req.policy, "req_id": fid}
+        if req.session is not None:
+            meta["session"] = req.session
+        if req.reset:
+            meta["reset"] = True
+        try:
+            link.channel.send("act", payload=req.obs, **meta)
+        except (ChannelClosed, OSError):
+            with self._lock:
+                link.pending.pop(fid, None)
+            self._retire(link, resubmit=True)
+            self._resubmit(req)
+
+    def _resubmit(self, req: _FrontRequest) -> None:
+        if req.pair_role == "incumbent":
+            return  # shadow lost its replica: the comparison is simply dropped
+        if req.pair_role == "canary":
+            req.pair, req.pair_role = None, None  # serve the client from the main pool
+        req.attempts += 1
+        if req.attempts > self.max_route_attempts:
+            self._reply_error(req, f"no live replica after {req.attempts} attempts")
+            return
+        self._submit(req)
+
+    def _park(self, req: _FrontRequest) -> None:
+        if req.pair_role == "incumbent":
+            return
+        with self._lock:
+            self._parked.append((req, time.monotonic() + self.park_timeout_s))
+
+    def _retry_parked(self) -> None:
+        with self._lock:
+            parked = list(self._parked)
+            self._parked.clear()
+            any_routable = any(routable(l.load) for l in self.replicas.values())
+        now = time.monotonic()
+        for req, deadline in parked:
+            if now >= deadline:
+                with self._lock:
+                    self.parked_expired += 1
+                self._reply_error(req, f"no replica became available within {self.park_timeout_s}s")
+            elif any_routable:
+                self._submit(req)
+            else:
+                with self._lock:
+                    self._parked.append((req, deadline))
+
+    def _reply_error(self, req: _FrontRequest, error: str) -> None:
+        with self._lock:
+            self.errors += 1
+        if req.channel is None:
+            return
+        try:
+            req.channel.send("error", req_id=req.req_id, error=error)
+        except (ChannelClosed, OSError):
+            with self._lock:
+                self.dropped += 1
+
+    # ------------------------------------------------------------------ readers
+    def _client_reader(self, ch: Channel) -> None:
+        while not ch.closed:
+            try:
+                kind, meta, payload = ch.recv(timeout=0.5)
+            except TimeoutError:
+                continue
+            except (ChannelClosed, FramingError, OSError):
+                return
+            try:
+                self._handle_client(ch, kind, meta, payload)
+            except ChannelClosed:
+                return
+
+    def _handle_client(self, ch: Channel, kind: str, meta: Dict[str, Any], payload: Any) -> None:
+        if kind == "ping":
+            with self._lock:
+                replicas = {
+                    name: {
+                        "alive": link.load.alive,
+                        "draining": link.load.draining,
+                        "inflight": len(link.pending),
+                        "canary": link.canary,
+                    }
+                    for name, link in self.replicas.items()
+                }
+                policies = sorted(self._policies)
+            ch.send(
+                "pong",
+                policies=policies,
+                aliases=policies,
+                draining=bool(self._draining),
+                fleet={
+                    "replicas": replicas,
+                    "canary": self.canary.summary() if self.canary else None,
+                },
+            )
+            return
+        if kind != "act":
+            ch.send("error", req_id=meta.get("req_id"), error=f"unknown message kind {kind!r}")
+            return
+        req_id = meta.get("req_id")
+        if self._draining:
+            with self._lock:
+                self.rejected_draining += 1
+            ch.send("draining", req_id=req_id)
+            return
+        if not isinstance(payload, dict):
+            ch.send("error", req_id=req_id, error="act payload must be an obs dict")
+            return
+        session = meta.get("session")
+        req = _FrontRequest(
+            channel=ch,
+            req_id=req_id,
+            policy=str(meta.get("policy", "")),
+            obs=payload,
+            session=str(session) if session is not None else None,
+            reset=bool(meta.get("reset", False)),
+            t_enq=time.monotonic(),
+        )
+        with self._lock:
+            self.accepted += 1
+        self._route_new(req)
+
+    def _replica_reader(self, link: ReplicaLink) -> None:
+        while True:
+            try:
+                kind, meta, payload = link.channel.recv(timeout=0.5)
+            except TimeoutError:
+                if link.retired:
+                    return
+                continue
+            except (ChannelClosed, FramingError, OSError):
+                break
+            if kind == "act_result":
+                self._on_act_result(link, meta, payload)
+            elif kind == "draining":
+                self._on_draining(link, meta)
+            elif kind == "pong":
+                self._on_pong(link, meta)
+            elif kind == "error":
+                self._on_replica_error(link, meta)
+        self._retire(link, resubmit=True)
+
+    def _on_act_result(self, link: ReplicaLink, meta: Dict[str, Any], payload: Any) -> None:
+        fid = meta.get("req_id")
+        with self._lock:
+            req = link.pending.pop(fid, None)
+            p99 = meta.get("p99_ms")
+            if isinstance(p99, (int, float)) and p99 == p99:
+                link.load.p99_ms = float(p99)
+        if req is None:
+            return
+        if req.pair is not None and req.pair_role is not None:
+            action = np.asarray((payload or {}).get("action"))
+            with req.pair.lock:
+                req.pair.actions[req.pair_role] = action
+                complete = len(req.pair.actions) == 2
+                actions = dict(req.pair.actions)
+            if complete and self.canary is not None:
+                self.canary.record(actions["incumbent"], actions["canary"])
+        if req.channel is None:
+            return  # shadow: accounted above, nothing to forward
+        latency_ms = (time.monotonic() - req.t_enq) * 1000.0
+        stamps = {
+            k: meta[k] for k in ("queue_ms", "infer_ms", "batch_fill", "bucket", "p99_ms") if k in meta
+        }
+        try:
+            req.channel.send(
+                "act_result", payload=payload, req_id=req.req_id, replica=link.name,
+                front_ms=latency_ms, **stamps,
+            )
+            with self._lock:
+                self.replied += 1
+            self.metrics.update("Fleet/latency_ms", latency_ms)
+        except (ChannelClosed, OSError):
+            with self._lock:
+                self.dropped += 1
+
+    def _on_draining(self, link: ReplicaLink, meta: Dict[str, Any]) -> None:
+        with self._lock:
+            was_draining = link.load.draining
+            link.load.draining = True
+            self.ring.remove(link.name)
+            req = link.pending.pop(meta.get("req_id"), None)
+            if req is not None:
+                self.rerouted += 1
+        if not was_draining:
+            self._log(f"replica {link.name} is draining; rerouting its traffic")
+        if req is not None:
+            self._resubmit(req)
+
+    def _on_pong(self, link: ReplicaLink, meta: Dict[str, Any]) -> None:
+        with self._lock:
+            link.load.draining = bool(meta.get("draining", link.load.draining))
+            if link.load.draining:
+                self.ring.remove(link.name)
+            depth = meta.get("queue_depth")
+            if isinstance(depth, (int, float)):
+                link.load.queue_depth = float(depth)
+            p99 = meta.get("p99_ms")
+            if isinstance(p99, (int, float)) and p99 == p99:
+                link.load.p99_ms = float(p99)
+            for p in meta.get("policies") or []:
+                self._policies.add(str(p))
+
+    def _on_replica_error(self, link: ReplicaLink, meta: Dict[str, Any]) -> None:
+        with self._lock:
+            req = link.pending.pop(meta.get("req_id"), None)
+        if req is None:
+            return
+        with self._lock:
+            self.errors += 1
+        if req.channel is not None:
+            try:
+                req.channel.send("error", req_id=req.req_id, error=meta.get("error"), replica=link.name)
+            except (ChannelClosed, OSError):
+                with self._lock:
+                    self.dropped += 1
+
+    # ------------------------------------------------------------------- probes
+    def _probe(self) -> None:
+        with self._lock:
+            links = list(self.replicas.values())
+        for link in links:
+            try:
+                link.channel.send("ping")
+            except (ChannelClosed, OSError):
+                pass  # the reader will retire it
+        self._merge_snapshot_loads()
+
+    def _merge_snapshot_loads(self) -> None:
+        """Best-effort merge of the PR-16 telemetry snapshot: replica-side queue
+        depth between pongs, matched by pid."""
+        fleet_dir = ((self.cfg.get("obs") or {}).get("fleet") or {}).get("dir")
+        if not fleet_dir:
+            return
+        try:
+            with open(os.path.join(str(fleet_dir), "snapshot.json")) as f:
+                snapshot = json.load(f)
+        except (OSError, ValueError):
+            return
+        by_pid = {
+            proc.get("pid"): proc.get("metrics") or {}
+            for proc in (snapshot.get("processes") or {}).values()
+            if proc.get("role") == "serve"
+        }
+        with self._lock:
+            for link in self.replicas.values():
+                metrics = by_pid.get(link.pid)
+                if not metrics:
+                    continue
+                depth = metrics.get("Serve/queue_depth")
+                if isinstance(depth, (int, float)):
+                    link.load.queue_depth = float(depth)
+                p99 = metrics.get("Serve/latency_p99_ms")
+                if isinstance(p99, (int, float)) and p99 == p99:
+                    link.load.p99_ms = float(p99)
+
+    # ------------------------------------------------------------------ serving
+    def run(self) -> int:
+        """Listen, route until stop/preemption, drain, summarize.  Returns 75
+        when preempted (the supervisor respawns the front) else 0."""
+        fleet_cfg = self.fleet_cfg
+        self.listener = Listener(host=str(fleet_cfg.host), port=int(fleet_cfg.port))
+        self._discover()
+        self._write_ready_file()
+        self._log(f"front listening on {self.listener.address}")
+        self._fleet = maybe_exporter(
+            self.cfg,
+            "front",
+            generation=int(os.environ.get("SHEEPRL_TPU_FAULT_RESTARTS", "0") or 0),
+        )
+        last_probe = 0.0
+        last_status = 0.0
+        threads: List[threading.Thread] = []
+        try:
+            while not self._stop.is_set() and not fault_preemption.preemption_requested():
+                try:
+                    ch = self.listener.accept(timeout=0.2)
+                except TimeoutError:
+                    pass
+                except OSError:
+                    break
+                else:
+                    with self._lock:
+                        self._channels.append(ch)
+                    t = threading.Thread(
+                        target=self._client_reader, args=(ch,), name="fleet-client", daemon=True
+                    )
+                    t.start()
+                    threads.append(t)
+                now = time.monotonic()
+                if now - last_probe >= self.probe_interval_s:
+                    last_probe = now
+                    # discovery dials out (connects can block on a dead
+                    # endpoint): keep it off the accept loop
+                    if self._discover_thread is None or not self._discover_thread.is_alive():
+                        self._discover_thread = threading.Thread(
+                            target=self._discover, name="fleet-discover", daemon=True
+                        )
+                        self._discover_thread.start()
+                    self._probe()
+                if now - last_status >= self.status_interval_s:
+                    last_status = now
+                    self._write_status()
+                    self._fleet_update()
+                self._retry_parked()
+        finally:
+            preempted = fault_preemption.preemption_requested()
+            self._drain()
+            self._write_status()
+            if self._fleet is not None:
+                self._fleet_update()
+                try:
+                    self._fleet.close()
+                except Exception:
+                    pass
+            self._write_summary(preempted=preempted)
+            self._close()
+        return fault_preemption.RESUMABLE_EXIT_CODE if preempted else 0
+
+    def shutdown(self) -> None:
+        """Clean stop (tests/benchmarks): same drain path, exit code 0."""
+        self._stop.set()
+
+    def _pending_total(self) -> int:
+        with self._lock:
+            return sum(len(l.pending) for l in self.replicas.values()) + len(self._parked)
+
+    def _drain(self) -> None:
+        """Stop admitting, let the replicas finish everything the front owes."""
+        self._draining = True
+        deadline = time.monotonic() + self.drain_timeout_s
+        while self._pending_total() > 0 and time.monotonic() < deadline:
+            self._retry_parked()
+            time.sleep(0.02)
+        with self._lock:
+            parked = list(self._parked)
+            self._parked.clear()
+        for req, _ in parked:
+            with self._lock:
+                self.parked_expired += 1
+            self._reply_error(req, "front shut down before a replica became available")
+
+    def _close(self) -> None:
+        if self.listener is not None:
+            self.listener.close()
+        with self._lock:
+            links = list(self.replicas.values())
+            channels = list(self._channels)
+        for link in links:
+            try:
+                link.channel.close()
+            except Exception:
+                pass
+        for ch in channels:
+            ch.close()
+
+    # ---------------------------------------------------------------- artifacts
+    def _write_ready_file(self) -> None:
+        ready = self.fleet_cfg.ready_file
+        if not ready:
+            return
+        with self._lock:
+            replicas = sorted(self.replicas)
+        _atomic_write_json(
+            Path(str(ready)),
+            {"host": self.listener.host, "port": self.listener.port, "replicas": replicas},
+        )
+
+    def _write_status(self) -> None:
+        if self.fleet_dir is None:
+            return
+        with self._lock:
+            replicas = {
+                name: {
+                    "inflight": len(link.pending),
+                    "queue_depth": link.load.queue_depth,
+                    "draining": link.load.draining,
+                    "canary": link.canary,
+                    "generation": link.generation,
+                    "routed": link.routed,
+                }
+                for name, link in self.replicas.items()
+            }
+            doc = {
+                "written": time.time(),
+                "draining": self._draining,
+                "live": sum(
+                    1 for l in self.replicas.values() if routable(l.load) and not l.canary
+                ),
+                "pending": sum(len(l.pending) for l in self.replicas.values()) + len(self._parked),
+                "parked": len(self._parked),
+                "accepted": self.accepted,
+                "replied": self.replied,
+                "rerouted": self.rerouted,
+                "replicas": replicas,
+            }
+        try:
+            _atomic_write_json(self.fleet_dir / "front_status.json", doc)
+        except OSError:
+            pass
+
+    def _fleet_update(self) -> None:
+        exporter = self._fleet
+        if exporter is None:
+            return
+        with self._lock:
+            routed_total = sum(l.routed for l in self.replicas.values())
+            shares = {
+                name: link.routed / max(routed_total, 1) for name, link in self.replicas.items()
+            }
+            live = sum(1 for l in self.replicas.values() if routable(l.load))
+            pending = sum(len(l.pending) for l in self.replicas.values()) + len(self._parked)
+            accepted, replied, rerouted = self.accepted, self.replied, self.rerouted
+            admitted, retired = self.replicas_admitted, self.replicas_retired
+        exporter.counter("requests_accepted", accepted)
+        exporter.counter("requests_replied", replied)
+        exporter.counter("requests_rerouted", rerouted)
+        exporter.gauge("Fleet/reroutes", rerouted)
+        exporter.gauge("Fleet/live_replicas", live)
+        exporter.gauge("Fleet/pending", pending)
+        exporter.gauge("Fleet/replicas_admitted", admitted)
+        exporter.gauge("Fleet/replicas_retired", retired)
+        for name, share in shares.items():
+            exporter.gauge(f"Fleet/share/{name}", share)
+        if self.canary is not None and self.canary.compared:
+            exporter.gauge("Fleet/canary_agreement", self.canary.agreement)
+        hist = self.metrics.metrics["Fleet/latency_ms"].compute()
+        if hist:
+            exporter.gauge("Fleet/latency_p99_ms", float(hist["p99"]))
+
+    def summary(self, preempted: bool = False) -> Dict[str, Any]:
+        with self._lock:
+            per_replica = {
+                name: {"routed": link.routed, "draining": link.load.draining, "canary": link.canary}
+                for name, link in self.replicas.items()
+            }
+            doc: Dict[str, Any] = {
+                "preempted": bool(preempted),
+                "accepted": self.accepted,
+                "replied": self.replied,
+                "rerouted": self.rerouted,
+                "errors": self.errors,
+                "dropped": self.dropped,
+                "rejected_draining": self.rejected_draining,
+                "parked_expired": self.parked_expired,
+                "replicas_admitted": self.replicas_admitted,
+                "replicas_retired": self.replicas_retired,
+                "replicas": per_replica,
+            }
+        computed = self.metrics.compute()
+        doc["p99_ms"] = computed.get("Fleet/latency_ms/p99")
+        doc["p50_ms"] = computed.get("Fleet/latency_ms/p50")
+        doc["canary"] = self.canary.summary() if self.canary else None
+        return doc
+
+    def _write_summary(self, preempted: bool) -> None:
+        path = os.environ.get(FRONT_SUMMARY_ENV_VAR) or self.fleet_cfg.summary_path
+        if not path:
+            return
+        _atomic_write_json(Path(str(path)), self.summary(preempted=preempted))
+
+    @staticmethod
+    def _log(msg: str) -> None:
+        print(f"[fleet-front] {msg}", flush=True)
+
+
+def _atomic_write_json(path: Path, doc: Dict[str, Any]) -> None:
+    import tempfile
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp_name = tempfile.mkstemp(prefix=f".{path.name}.", suffix=".tmp", dir=path.parent)
+    with os.fdopen(fd, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp_name, path)

@@ -1,0 +1,113 @@
+"""Fleet routing decisions: least-loaded selection + session-affine hashing.
+
+Pure functions and a small ring class — no sockets, no threads — so the unit
+tests pin the routing contract directly and the front just feeds it live
+numbers.
+
+Least-loaded: the score of a replica is its in-flight request count (the
+front's own ledger, exact) plus the queue depth its last pong/telemetry row
+reported (the replica-side backlog the front has not seen replies for yet).
+Ties break on the replica's rolling p99 and then on name, so selection is
+deterministic for a given load picture.
+
+Session affinity: a consistent-hash ring (stable points per replica via
+``blake2b``).  A session hashes to the first ring point clockwise of it, so
+
+* the same session always lands on the same live replica (hash stability),
+* adding a replica only steals the sessions between the new points and their
+  predecessors (minimal churn), and
+* removing a dead replica reassigns ONLY its sessions, each to the next point
+  clockwise — everyone else keeps their slot (reassignment-on-death).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class ReplicaLoad:
+    """One replica's load picture, as the front currently believes it."""
+
+    inflight: int = 0  # requests the front has sent and not seen replied
+    queue_depth: float = 0.0  # replica-reported backlog (pong / fleet telemetry)
+    p99_ms: float = math.nan  # rolling reply-stamp p99
+    draining: bool = False
+    alive: bool = True
+
+    @property
+    def score(self) -> float:
+        return float(self.inflight) + float(self.queue_depth)
+
+
+def routable(load: ReplicaLoad) -> bool:
+    return load.alive and not load.draining
+
+
+def pick_replica(loads: Dict[str, ReplicaLoad], exclude: Tuple[str, ...] = ()) -> Optional[str]:
+    """The least-loaded live, non-draining replica; ``None`` when nothing is
+    routable.  ``exclude`` removes candidates (e.g. the canary, or the replica
+    a request just bounced off)."""
+    best: Optional[str] = None
+    best_key: Optional[Tuple[float, float, str]] = None
+    for name, load in loads.items():
+        if name in exclude or not routable(load):
+            continue
+        p99 = load.p99_ms if load.p99_ms == load.p99_ms else float("inf")  # NaN-safe
+        key = (load.score, p99, name)
+        if best_key is None or key < best_key:
+            best, best_key = name, key
+    return best
+
+
+def _point(label: str) -> int:
+    return int.from_bytes(hashlib.blake2b(label.encode(), digest_size=8).digest(), "big")
+
+
+@dataclass
+class HashRing:
+    """Consistent-hash ring for session-affine routing.
+
+    ``vnodes`` virtual points per member keep the session shares balanced
+    (~1/sqrt(vnodes) relative spread); 64 is plenty for single-digit fleets.
+    """
+
+    vnodes: int = 64
+    _points: List[Tuple[int, str]] = field(default_factory=list)
+    _members: Dict[str, List[int]] = field(default_factory=dict)
+
+    def add(self, member: str) -> None:
+        if member in self._members:
+            return
+        points = [_point(f"{member}#{i}") for i in range(self.vnodes)]
+        self._members[member] = points
+        for p in points:
+            bisect.insort(self._points, (p, member))
+
+    def remove(self, member: str) -> None:
+        points = self._members.pop(member, None)
+        if points is None:
+            return
+        drop = set(points)
+        self._points = [(p, m) for p, m in self._points if not (m == member and p in drop)]
+
+    def members(self) -> List[str]:
+        return sorted(self._members)
+
+    def __contains__(self, member: str) -> bool:
+        return member in self._members
+
+    def assign(self, session: str) -> Optional[str]:
+        """The member owning ``session`` (first ring point clockwise); ``None``
+        on an empty ring."""
+        if not self._points:
+            return None
+        h = _point(f"session:{session}")
+        i = bisect.bisect_right(self._points, (h, "￿"))
+        if i == len(self._points):
+            i = 0
+        return self._points[i][1]

@@ -59,8 +59,15 @@ def precompile_ladder(policy, ladder: Sequence[int]) -> Tuple[Dict[int, Any], fl
     compiled: Dict[int, Any] = {}
     for bucket in ladder:
         obs = policy.zero_obs(int(bucket))
-        exe = jitted.lower(policy.params, obs, key).compile()
-        jax.block_until_ready(exe(policy.params, obs, key))
+        if getattr(policy, "stateful", False):
+            # Stateful signature: (params, obs, is_first, state, key) -> (actions, new_state).
+            is_first = np.ones((int(bucket), 1), np.float32)
+            state = policy.zero_state_fn(int(bucket))
+            exe = jitted.lower(policy.params, obs, is_first, state, key).compile()
+            jax.block_until_ready(exe(policy.params, obs, is_first, state, key))
+        else:
+            exe = jitted.lower(policy.params, obs, key).compile()
+            jax.block_until_ready(exe(policy.params, obs, key))
         compiled[int(bucket)] = exe
     return compiled, time.perf_counter() - t0
 

@@ -25,10 +25,14 @@ Threads (all I/O-bound; the GIL is irrelevant because dispatch blocks in XLA):
 
 Wire protocol (framed transport from ``distributed.transport``):
 
-* ``("ping", {}) → ("pong", {policies, draining})`` — readiness probe;
+* ``("ping", {}) → ("pong", {policies, draining, queue_depth, p99_ms})`` —
+  readiness + load probe (the fleet front routes on the load stamps);
 * ``("act", {policy, req_id}, obs_dict) → ("act_result", {req_id, queue_ms,
   infer_ms, batch_fill, bucket, p99_ms}, {"action": row})`` — one observation
-  in, one action out;
+  in, one action out; stateful (recurrent) policies also accept ``session``
+  (client id whose device-resident act state continues across requests —
+  :class:`~sheeprl_tpu.serve.state_cache.SessionStateCache`) and ``reset``
+  (force an episode restart for that session);
 * ``("act", ...) during drain → ("draining", {req_id})`` — the client retries
   against another replica;
 * unknown policy / malformed obs → ``("error", {req_id, error})``.
@@ -57,7 +61,7 @@ from sheeprl_tpu.distributed.transport import Channel, ChannelClosed, Listener
 from sheeprl_tpu.fault import preemption as fault_preemption
 from sheeprl_tpu.obs.fleet import maybe_exporter
 from sheeprl_tpu.serve.batching import bucket_ladder, collect_batch, pad_obs_batch, pick_bucket
-from sheeprl_tpu.serve.precompile import dispatch_key, precompile_ladder
+from sheeprl_tpu.serve.precompile import dispatch_key, precompile_ladder, zero_key
 from sheeprl_tpu.serve.router import resolve_policy
 from sheeprl_tpu.utils.metric import MetricAggregator
 
@@ -71,6 +75,8 @@ class _Request:
     req_id: Any
     obs: Dict[str, np.ndarray]
     t_enq: float
+    session: Optional[str] = None  # stateful policies: the client id owning act state
+    reset: bool = False  # force an episode restart for that session
 
 
 class _Endpoint:
@@ -86,6 +92,7 @@ class _Endpoint:
         self.ladder = ladder
         self.queue: "_queue.Queue[_Request]" = _queue.Queue(maxsize=queue_depth)
         self.seed = seed
+        self.state_cache = None  # SessionStateCache for stateful policies
         self.dispatch_counter = 0
         self.accepted = 0
         self.replied = 0
@@ -206,6 +213,25 @@ class PolicyServer:
                 queue_depth=int(self.serve_cfg.queue_depth),
                 seed=seed,
             )
+            if policy.stateful:
+                from sheeprl_tpu.serve.state_cache import SessionStateCache
+
+                ep.state_cache = SessionStateCache(
+                    policy.zero_state_fn, capacity=int(self.serve_cfg.session_capacity)
+                )
+
+                # Warm gather/scatter THROUGH the compiled act fn: its output
+                # sharding is what dispatch-time scatters (and, once committed
+                # to the storage, gathers) trace against.
+                def _warm_step(bucket: int, state: Any, _ep: _Endpoint = ep) -> Any:
+                    warm_obs = _ep.policy.zero_obs(bucket)
+                    warm_first = np.ones((bucket, 1), np.float32)
+                    _, new_state = _ep.compiled[bucket](
+                        _ep.policy.params, warm_obs, warm_first, state, zero_key()
+                    )
+                    return new_state
+
+                ep.state_cache.warmup(ladder, step_fn=_warm_step)
             self.endpoints[canonical] = ep
             self._register_aliases(spec, ep, entry)
             print(
@@ -248,10 +274,13 @@ class PolicyServer:
             t.start()
             self._threads.append(t)
         # Fleet telemetry: the replica generation is the supervisor's restart
-        # counter, so respawned replicas land in a fresh snapshot slot lineage.
+        # counter, so respawned replicas land in a fresh snapshot slot lineage;
+        # the fleet manager numbers replica slots via SHEEPRL_TPU_SERVE_SLOT so
+        # N replicas show as serve0..serveN-1 instead of colliding on serve0.
         self._fleet = maybe_exporter(
             self.cfg,
             "serve",
+            actor_id=int(os.environ.get("SHEEPRL_TPU_SERVE_SLOT", "0") or 0),
             generation=int(os.environ.get("SHEEPRL_TPU_FAULT_RESTARTS", "0") or 0),
         )
         last_log = time.monotonic()
@@ -311,6 +340,13 @@ class PolicyServer:
 
     def _handle(self, ch: Channel, kind: str, meta: Dict[str, Any], payload: Any) -> None:
         if kind == "ping":
+            p99 = float("nan")
+            for ep in self.endpoints.values():
+                hist = ep.metrics.metrics["Serve/latency_ms"].compute()
+                if hist:
+                    p = float(hist["p99"])
+                    if not (p99 == p99) or p > p99:  # max over endpoints, NaN-safe
+                        p99 = p
             ch.send(
                 "pong",
                 policies=sorted(self.endpoints),
@@ -318,6 +354,9 @@ class PolicyServer:
                 draining=bool(self._draining),
                 precision=self.precision,
                 parity=self.parity,
+                # Load stamps: the fleet front's routing probe.
+                queue_depth=sum(ep.queue.qsize() for ep in self.endpoints.values()),
+                p99_ms=p99 if p99 == p99 else None,
             )
             return
         if kind != "act":
@@ -341,7 +380,17 @@ class PolicyServer:
         if not isinstance(payload, dict):
             ch.send("error", req_id=req_id, error="act payload must be an obs dict")
             return
-        ep.queue.put(_Request(channel=ch, req_id=req_id, obs=payload, t_enq=time.monotonic()))
+        session = meta.get("session")
+        ep.queue.put(
+            _Request(
+                channel=ch,
+                req_id=req_id,
+                obs=payload,
+                t_enq=time.monotonic(),
+                session=str(session) if session is not None else None,
+                reset=bool(meta.get("reset", False)),
+            )
+        )
         ep.accepted += 1
 
     # --------------------------------------------------------------- dispatcher
@@ -385,7 +434,21 @@ class PolicyServer:
         key = dispatch_key(ep.seed, ep.dispatch_counter)
         ep.dispatch_counter += 1
         t0 = time.monotonic()
-        actions = np.asarray(jax.device_get(ep.compiled[bucket](ep.policy.params, obs, key)))
+        if ep.state_cache is not None:
+            # Stateful dispatch: map sessions to device state rows, pad with the
+            # scratch row (padding scatters there harmlessly), one recurrent step.
+            cache = ep.state_cache
+            idx, is_first = cache.assign([r.session for r in batch], [r.reset for r in batch])
+            idx_p = np.full((bucket,), cache.scratch, np.int32)
+            idx_p[:n] = idx
+            is_first_p = np.ones((bucket, 1), np.float32)
+            is_first_p[:n] = is_first
+            state = cache.gather(idx_p)
+            out, new_state = ep.compiled[bucket](ep.policy.params, obs, is_first_p, state, key)
+            actions = np.asarray(jax.device_get(out))
+            cache.scatter(idx_p, new_state)
+        else:
+            actions = np.asarray(jax.device_get(ep.compiled[bucket](ep.policy.params, obs, key)))
         t1 = time.monotonic()
 
         new_compiles = self.watchdog.poll_new() if self.watchdog is not None else 0
@@ -516,6 +579,8 @@ class PolicyServer:
                 "slo_violations": ep.slo_violations,
                 "metrics": ep.metrics.compute(),
             }
+            if ep.state_cache is not None:
+                per_policy[canonical]["sessions"] = ep.state_cache.stats()
         total_replied = sum(ep.replied for ep in self.endpoints.values())
         total_violations = sum(ep.slo_violations for ep in self.endpoints.values())
         return {

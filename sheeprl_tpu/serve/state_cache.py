@@ -1,0 +1,119 @@
+"""Device-resident per-session act-state for stateful (recurrent) policies.
+
+A recurrent policy's act fn is ``act_fn(params, obs, is_first, state, key) ->
+(actions, new_state)`` — the state (LSTM carry / attention window + the
+previous one-hot action) must survive between requests, per client.  This cache
+keeps it on device as ONE preallocated pytree of ``capacity + 1`` rows (slot
+``capacity`` is scratch) and maps session ids to rows host-side:
+
+* :meth:`assign` turns a batch's session ids into row indices + the ``is_first``
+  column: a session seen before continues its episode (``is_first=0``); a new,
+  evicted-and-returning, or explicitly ``reset`` session starts fresh
+  (``is_first=1`` — the recurrent step masks the stale row in-graph, so slots
+  never need host-side zeroing);
+* :meth:`gather` / :meth:`scatter` are jitted row gather/scatter, one trace per
+  batch-bucket shape (:meth:`warmup` pre-traces them alongside the act ladder
+  so steady-state serving never compiles);
+* eviction is LRU; session-less requests ride the scratch row (``is_first=1``),
+  and the server pads short batches with scratch indices so padding rows
+  scatter harmlessly.
+
+A batch holding the same session twice is last-write-wins on the scatter (row
+order); the front's session-affine routing makes that a same-client pipelining
+artifact, not a correctness hazard.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class SessionStateCache:
+    """Not thread-safe by design: owned by the server's dispatch loop."""
+
+    def __init__(self, zero_state_fn: Callable[[int], Any], capacity: int):
+        import jax
+
+        self.capacity = int(capacity)
+        self.scratch = self.capacity  # the extra row: session-less + padding traffic
+        self.storage = zero_state_fn(self.capacity + 1)
+        self._slots: "OrderedDict[str, int]" = OrderedDict()  # session -> row, LRU order
+        self._free: List[int] = list(range(self.capacity))
+        self.evictions = 0
+        self._gather = jax.jit(lambda storage, idx: jax.tree.map(lambda x: x[idx], storage))
+        self._scatter = jax.jit(
+            lambda storage, idx, rows: jax.tree.map(
+                lambda s, r: s.at[idx].set(r.astype(s.dtype)), storage, rows
+            )
+        )
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def assign(
+        self, sessions: Sequence[Optional[str]], resets: Sequence[bool]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Row index + ``is_first`` per request.  Mutates the LRU order."""
+        n = len(sessions)
+        idx = np.full((n,), self.scratch, np.int32)
+        is_first = np.ones((n, 1), np.float32)
+        for i, (session, reset) in enumerate(zip(sessions, resets)):
+            if session is None:
+                continue  # scratch row, fresh state
+            slot = self._slots.get(session)
+            if slot is None:
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    _, slot = self._slots.popitem(last=False)  # evict the LRU session
+                    self.evictions += 1
+                self._slots[session] = slot
+            else:
+                self._slots.move_to_end(session)
+                if not reset:
+                    is_first[i, 0] = 0.0
+            idx[i] = slot
+        return idx, is_first
+
+    def gather(self, idx: np.ndarray) -> Any:
+        return self._gather(self.storage, idx)
+
+    def scatter(self, idx: np.ndarray, rows: Any) -> None:
+        self.storage = self._scatter(self.storage, idx, rows)
+
+    def drop(self, session: str) -> None:
+        slot = self._slots.pop(session, None)
+        if slot is not None:
+            self._free.append(slot)
+
+    def warmup(
+        self, buckets: Sequence[int], step_fn: Optional[Callable[[int, Any], Any]] = None
+    ) -> None:
+        """Trace gather/scatter per bucket shape before the server goes warm.
+
+        ``step_fn(bucket, state) -> new_state`` runs the policy's compiled act
+        between the gather and the scatter.  That matters beyond coverage: the
+        act output's leaves carry the mesh's NamedSharding, which the jit cache
+        keys on — and the first real scatter also commits that sharding onto
+        the storage.  Two passes: pass one scatters act output into fresh
+        storage (committing the sharding), pass two traces every bucket's
+        gather/scatter against the now-committed storage — the steady-state
+        signatures, so serving never compiles."""
+        order = sorted(set(int(b) for b in buckets))
+        for _ in range(2 if step_fn is not None else 1):
+            for bucket in order:
+                idx = np.full((bucket,), self.scratch, np.int32)
+                rows = self.gather(idx)
+                if step_fn is not None:
+                    rows = step_fn(bucket, rows)
+                self.scatter(idx, rows)
+
+    def stats(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "sessions": len(self._slots),
+            "evictions": self.evictions,
+        }

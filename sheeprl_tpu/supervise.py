@@ -8,6 +8,11 @@ and ``howto/fault_tolerance.md``.
 ``python -m sheeprl_tpu.serve`` replica alive instead — a SIGTERM'd replica
 drains its accepted requests, exits 75, and is respawned immediately
 (``howto/serving.md``).
+
+``--serve`` with ``serve.fleet.enabled=True`` runs the whole serving *fleet*:
+the load-balancing front plus ``serve.fleet.min_replicas`` replicas, per-slot
+respawn, queue-depth autoscaling up to ``serve.fleet.max_replicas``, and an
+optional canary replica (``howto/serving.md`` "Fleet").
 """
 
 from sheeprl_tpu.fault.supervisor import main

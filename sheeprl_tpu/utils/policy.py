@@ -9,17 +9,22 @@ params out of whatever layout the run used (host-loop ``params``, Anakin scan
 size.  This module is that pipeline, factored out of the per-algo ``evaluate``
 entries so the serve tier does not duplicate it.
 
-Servable families (stateless feed-forward policies):
+Servable families:
 
 * ``ppo`` — ``ppo``, ``ppo_decoupled``, ``a2c``: dict observations through the
   shared encoder; greedy mode takes the distribution mode.
 * ``sac`` — ``sac``, ``sac_decoupled``: vector observations concatenated in-graph;
   the action is ``tanh(mean)`` rescaled to the env bounds (the reference's
   eval-time policy).
+* ``ppo_recurrent`` — *stateful*: the act fn is ``act_fn(params, obs, is_first,
+  state, key) -> (actions, new_state)`` where ``state = {"rnn": carry, "prev":
+  one-hot prev actions}``; ``LoadedPolicy.stateful`` is True and
+  ``zero_state_fn(n)`` builds the fresh-episode state batch.  The serve tier
+  keeps the state device-resident per session
+  (:class:`sheeprl_tpu.serve.state_cache.SessionStateCache`).
 
-Recurrent and world-model policies (``ppo_recurrent``, the Dreamer family) carry
-per-client latent state between steps — a stateless request/reply server cannot
-serve them; :func:`policy_family` rejects them with an actionable error.
+World-model policies (the Dreamer family) are not reconstructable through this
+path; :func:`policy_family` rejects them with an actionable error.
 """
 
 from __future__ import annotations
@@ -32,18 +37,22 @@ import numpy as np
 #: algo name -> servable family
 PPO_FAMILY = ("ppo", "ppo_decoupled", "a2c")
 SAC_FAMILY = ("sac", "sac_decoupled")
+RECURRENT_PPO_FAMILY = ("ppo_recurrent",)
 
 
 def policy_family(algo_name: str) -> str:
-    """The act-fn family for ``algo_name``; raises for stateful policies."""
+    """The act-fn family for ``algo_name``; raises for world-model policies."""
     if algo_name in PPO_FAMILY:
         return "ppo"
     if algo_name in SAC_FAMILY:
         return "sac"
+    if algo_name in RECURRENT_PPO_FAMILY:
+        return "ppo_recurrent"
     raise ValueError(
-        f"algorithm {algo_name!r} has no stateless act-fn builder: only "
-        f"{', '.join(PPO_FAMILY + SAC_FAMILY)} can be evaluated/served through this "
-        "path (recurrent and world-model policies carry per-step latent state)"
+        f"algorithm {algo_name!r} has no act-fn builder: only "
+        f"{', '.join(PPO_FAMILY + SAC_FAMILY + RECURRENT_PPO_FAMILY)} can be "
+        "evaluated/served through this path (world-model policies rebuild "
+        "through their own evaluate entries)"
     )
 
 
@@ -79,6 +88,8 @@ class LoadedPolicy:
     action_dims: List[int]
     cfg: Any = field(repr=False, default=None)
     precision: str = "f32"  # serving precision tier: f32 | bf16 | int8
+    stateful: bool = False  # act fn threads per-session state (recurrent families)
+    zero_state_fn: Optional[Callable[[int], Any]] = field(repr=False, default=None)
 
     def zero_obs(self, batch: int) -> Dict[str, np.ndarray]:
         """A zero-filled obs batch matching the template (precompile ladders)."""
@@ -95,6 +106,45 @@ def _ppo_act_fn(agent, greedy: bool):
         actor_out, _ = agent.apply(params, obs)
         env_act, _, _ = sample_actions(key, actor_out, agent.is_continuous, greedy=greedy)
         return env_act
+
+    return act_fn
+
+
+def _recurrent_ppo_act_fn(agent, greedy: bool):
+    """Stateful act fn: one recurrent step per request batch.
+
+    ``state = {"rnn": carry, "prev": [B, sum(action_dims)] float32}``.  The
+    previous action is re-encoded in-graph (one-hot per discrete head, raw for
+    continuous) exactly as the training loop's ``_onehot_actions``; episode
+    starts need no host-side zeroing because ``RecurrentPPOAgent.step`` masks
+    both the carry and ``prev`` by ``is_first`` in-graph.  The new state is cast
+    to float32 so cached storage keeps one dtype across precision tiers.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.ppo.utils import sample_actions
+    from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent
+
+    dims = [int(d) for d in agent.action_dims]
+
+    def act_fn(params, obs, is_first, state, key):
+        actor_out, _, new_rnn = agent.apply(
+            params, obs, state["prev"], is_first, state["rnn"], method=RecurrentPPOAgent.step
+        )
+        env_act, _, _ = sample_actions(key, actor_out, agent.is_continuous, greedy=greedy)
+        if agent.is_continuous:
+            prev = env_act.astype(jnp.float32)
+        else:
+            prev = jnp.concatenate(
+                [jax.nn.one_hot(env_act[..., i], d, dtype=jnp.float32) for i, d in enumerate(dims)],
+                axis=-1,
+            )
+        new_state = {
+            "rnn": jax.tree.map(lambda x: x.astype(jnp.float32), new_rnn),
+            "prev": prev,
+        }
+        return env_act, new_state
 
     return act_fn
 
@@ -144,7 +194,9 @@ def build_policy(ctx, cfg, obs_space, act_space, greedy: bool = True) -> Tuple[L
     algo = cfg.algo.name
     family = policy_family(algo)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
-    cnn_keys = list(cfg.algo.cnn_keys.encoder) if family == "ppo" else []
+    cnn_keys = list(cfg.algo.cnn_keys.encoder) if family in ("ppo", "ppo_recurrent") else []
+    stateful = False
+    zero_state_fn = None
     if family == "ppo":
         from sheeprl_tpu.algos.ppo.agent import build_agent
 
@@ -153,6 +205,23 @@ def build_policy(ctx, cfg, obs_space, act_space, greedy: bool = True) -> Tuple[L
         act_params = params
         is_continuous = bool(agent.is_continuous)
         action_dims = [int(d) for d in agent.action_dims]
+    elif family == "ppo_recurrent":
+        import jax.numpy as jnp
+
+        from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent, make_zero_state
+
+        agent, params = build_agent(ctx, act_space, obs_space, cfg)
+        act_fn = _recurrent_ppo_act_fn(agent, greedy)
+        act_params = params
+        is_continuous = bool(agent.is_continuous)
+        action_dims = [int(d) for d in agent.action_dims]
+        stateful = True
+        zero_rnn = make_zero_state(cfg)
+        act_sum = int(sum(action_dims))
+
+        def zero_state_fn(n: int, _zero_rnn=zero_rnn, _act_sum=act_sum):
+            return {"rnn": _zero_rnn(n), "prev": jnp.zeros((n, _act_sum), jnp.float32)}
+
     else:
         from sheeprl_tpu.algos.sac.agent import build_agent
 
@@ -170,6 +239,8 @@ def build_policy(ctx, cfg, obs_space, act_space, greedy: bool = True) -> Tuple[L
         is_continuous=is_continuous,
         action_dims=action_dims,
         cfg=cfg,
+        stateful=stateful,
+        zero_state_fn=zero_state_fn,
     )
     return policy, params
 
@@ -208,8 +279,8 @@ def wrap_policy_precision(policy: LoadedPolicy, precision: Any) -> LoadedPolicy:
         policy.params = quantize_params(policy.params)
         base_act_fn = policy.act_fn
 
-        def act_fn(params, obs, key):
-            return base_act_fn(dequantize_params(params), obs, key)
+        def act_fn(params, *rest):  # same trailing args for stateless and stateful fns
+            return base_act_fn(dequantize_params(params), *rest)
 
         policy.act_fn = act_fn
         policy.precision = "int8"
@@ -233,8 +304,20 @@ def parity_stamp(policy: LoadedPolicy, reference: LoadedPolicy, n_obs: int = 256
         else:
             obs[k] = rng.standard_normal((n_obs, *shape)).astype(np.dtype(dtype))
     key = np.zeros((2,), np.uint32)
-    got = jax.device_get(jax.jit(policy.act_fn)(policy.params, obs, key))
-    want = jax.device_get(jax.jit(reference.act_fn)(reference.params, obs, key))
+    if policy.stateful:
+        # Fresh-episode step: zero state + is_first=1 on both sides, compare actions.
+        is_first = np.ones((n_obs, 1), np.float32)
+        got = jax.device_get(
+            jax.jit(policy.act_fn)(policy.params, obs, is_first, policy.zero_state_fn(n_obs), key)[0]
+        )
+        want = jax.device_get(
+            jax.jit(reference.act_fn)(
+                reference.params, obs, is_first, reference.zero_state_fn(n_obs), key
+            )[0]
+        )
+    else:
+        got = jax.device_get(jax.jit(policy.act_fn)(policy.params, obs, key))
+        want = jax.device_get(jax.jit(reference.act_fn)(reference.params, obs, key))
     return {
         "precision": policy.precision,
         "reference": reference.precision,

@@ -1,0 +1,83 @@
+"""The serve-mode relaunch loop (``supervise --serve``): crash-retry vs
+clean-preemption accounting, backoff reset on a healthy drain, and the
+lifetime summary JSON on every exit path.  The child is a stub — no replica
+process is ever spawned."""
+
+import json
+import subprocess
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from sheeprl_tpu.fault.counters import RESTARTS_ENV_VAR
+from sheeprl_tpu.fault.supervisor import SUPERVISE_SUMMARY_ENV_VAR, supervise_serve
+
+
+@pytest.fixture
+def loop(tmp_path, monkeypatch):
+    """Run supervise_serve against a scripted sequence of child exit codes,
+    capturing backoff sleeps and the env each attempt was launched with."""
+    monkeypatch.delenv(SUPERVISE_SUMMARY_ENV_VAR, raising=False)
+    summary_path = tmp_path / "summary.json"
+    calls = SimpleNamespace(sleeps=[], restarts=[], argvs=[])
+
+    def run(rcs, extra=()):
+        seq = iter(rcs)
+
+        def fake_run(argv, env=None, **kwargs):
+            calls.argvs.append(argv)
+            calls.restarts.append(env[RESTARTS_ENV_VAR])
+            return SimpleNamespace(returncode=next(seq))
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        monkeypatch.setattr(time, "sleep", lambda s: calls.sleeps.append(s))
+        rc = supervise_serve(
+            [
+                f"fault.summary_path={summary_path}",
+                "fault.max_retries=3",
+                "fault.backoff_s=2.0",
+                "fault.backoff_max_s=60.0",
+                *extra,
+            ]
+        )
+        return rc, json.loads(summary_path.read_text())
+
+    return run, calls
+
+
+def test_preemption_is_not_a_crash_and_resets_the_backoff(loop):
+    run, calls = loop
+    # crash, drained preemption, crash, crash, clean shutdown
+    rc, summary = run([1, 75, 1, 1, 0])
+    assert rc == 0
+    # the preemption respawned with NO sleep, and reset the consecutive-crash
+    # clock: the post-preemption crashes back off from the base again
+    assert calls.sleeps == [2.0, 2.0, 4.0]
+    assert summary["outcome"] == "clean" and summary["rc"] == 0
+    assert summary["attempts"] == 5
+    assert summary["retries"] == 3  # total crashes, separate from...
+    assert summary["preemptions"] == 1  # ...clean preemptions
+    assert [e["kind"] for e in summary["events"]] == [
+        "crash", "preemption", "crash", "crash",
+    ]
+    # every attempt told the child its lineage position
+    assert calls.restarts == ["0", "1", "2", "3", "4"]
+
+
+def test_retry_budget_exhaustion_writes_the_summary(loop):
+    run, calls = loop
+    rc, summary = run([2, 2, 2, 2], extra=("fault.max_retries=3",))
+    assert rc == 2
+    assert summary["outcome"] == "retry_budget" and summary["rc"] == 2
+    assert summary["retries"] == 4 and summary["preemptions"] == 0
+    assert calls.sleeps == [2.0, 4.0, 8.0]  # the final crash exits, no sleep
+
+
+def test_preemption_budget_bounds_eternal_respawns(loop):
+    run, calls = loop
+    rc, summary = run([75, 75], extra=("fault.max_preemptions=1",))
+    assert rc == 75
+    assert summary["outcome"] == "preemption_budget"
+    assert summary["preemptions"] == 2 and summary["retries"] == 0
+    assert calls.sleeps == []  # preemptions never back off

@@ -395,6 +395,40 @@ def test_top_rebuilds_from_timeline_tail(tmp_path):
     assert "actor1" in table and "12.5" in table
 
 
+def test_top_renders_fleet_front_detail_line():
+    """A front slot gets the router detail line: per-replica routed share,
+    reroute count, admit/retire tallies and canary agreement."""
+    snap = {
+        "fleet_dir": "/tmp/f",
+        "processes": {
+            "front0": {
+                "role": "front",
+                "generation": 0,
+                "pid": 7,
+                "alive": True,
+                "wall_clock": time.time(),
+                "metrics": {
+                    "Fleet/pending": 3,
+                    "Fleet/latency_p99_ms": 8.5,
+                    "Fleet/reroutes": 2,
+                    "Fleet/replicas_admitted": 3,
+                    "Fleet/replicas_retired": 1,
+                    "Fleet/live_replicas": 2,
+                    "Fleet/canary_agreement": 0.995,
+                    "Fleet/share/replica0": 0.75,
+                    "Fleet/share/replica1": 0.25,
+                },
+            },
+        },
+    }
+    table = fleet_top.format_top(snap)
+    assert "front0" in table and "8.5" in table  # QDEPTH/P99 via Fleet/ gauges
+    detail = next(line for line in table.splitlines() if line.startswith("front front0:"))
+    assert "replica0=75%" in detail and "replica1=25%" in detail
+    assert "reroutes=2" in detail and "replicas +3/-1" in detail
+    assert "live=2" in detail and "canary_agreement=0.995" in detail
+
+
 # ----------------------------------------------------------- trace_summary tie
 def test_trace_summary_folds_fleet_timeline(tmp_path):
     trace_summary = _load_bench_module("trace_summary")

@@ -1,0 +1,338 @@
+"""FleetFront + FleetClient against fake replicas: least-loaded routing,
+draining bounce, death reroute (zero accepted-request loss), session affinity
+reassignment, canary shadow accounting, park/admit.
+
+The fakes speak the serve wire protocol over the real framed transport but
+never import JAX — this pins the ROUTER's contract, not the server's (which
+``test_server.py`` owns)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.distributed.transport import ChannelClosed, FramingError, Listener
+from sheeprl_tpu.serve.client import FleetClient, PolicyClient
+from sheeprl_tpu.serve.fleet.front import FleetFront
+
+OBS = {"state": np.zeros(2, dtype=np.float32)}
+
+
+class FakeReplica:
+    """A protocol-faithful policy-server stand-in: pong with load stats, echo a
+    fixed action row per act.  ``mode="draining"`` bounces every act (but pongs
+    healthy — the race the front's instant reroute exists for); ``hold.set()``
+    accepts acts without replying (in-flight fodder for kill tests)."""
+
+    def __init__(self, action=(0,), mode="echo"):
+        self.listener = Listener(host="127.0.0.1", port=0)
+        self.port = self.listener.port
+        self.action = np.asarray(action)
+        self.mode = mode
+        self.served = []  # (policy, session, reset) per act received
+        self.hold = threading.Event()
+        self.channels = []
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                ch = self.listener.accept(timeout=0.2)
+            except TimeoutError:
+                continue
+            except OSError:
+                return
+            self.channels.append(ch)
+            threading.Thread(target=self._serve, args=(ch,), daemon=True).start()
+
+    def _serve(self, ch):
+        while not self._stop.is_set():
+            try:
+                kind, meta, payload = ch.recv(timeout=0.2)
+            except TimeoutError:
+                continue
+            except (ChannelClosed, FramingError, OSError):
+                return
+            try:
+                if kind == "ping":
+                    ch.send("pong", policies=["m:1"], aliases=["m:1"], draining=False,
+                            queue_depth=0, p99_ms=1.0)
+                elif kind == "act":
+                    fid = meta.get("req_id")
+                    self.served.append(
+                        (meta.get("policy"), meta.get("session"), bool(meta.get("reset")))
+                    )
+                    if self.mode == "draining":
+                        ch.send("draining", req_id=fid)
+                    elif self.hold.is_set():
+                        pass  # accepted, never answered: in-flight until the kill
+                    else:
+                        ch.send("act_result", req_id=fid, payload={"action": self.action},
+                                queue_ms=0.1, infer_ms=0.2, batch_fill=1.0, bucket=1,
+                                p99_ms=1.0)
+            except (ChannelClosed, OSError):
+                return
+
+    def acts(self):
+        return [row for row in self.served]
+
+    def kill(self):
+        """SIGKILL equivalent: listener and every channel die abruptly."""
+        self._stop.set()
+        self.listener.close()
+        for ch in self.channels:
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+
+def _start_front(endpoints, extra=()):
+    from sheeprl_tpu.config.core import compose
+
+    cfg = compose(
+        config_name="serve_cli",
+        overrides=[
+            "serve.fleet.enabled=True",
+            f"serve.fleet.replicas=[{','.join(endpoints)}]",
+            "serve.fleet.host=127.0.0.1",
+            "serve.fleet.port=0",
+            "serve.fleet.probe_interval_s=0.1",
+            "serve.fleet.status_interval_s=0.1",
+            "serve.fleet.park_timeout_s=3.0",
+            "serve.drain_timeout_s=5.0",
+            *extra,
+        ],
+    )
+    front = FleetFront(cfg)
+    rc_box = {}
+    thread = threading.Thread(target=lambda: rc_box.update(rc=front.run()), daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 10.0
+    while front.listener is None:
+        assert time.monotonic() < deadline, "front never started listening"
+        time.sleep(0.01)
+    return front, thread, rc_box
+
+
+def _stop_front(front, thread, rc_box):
+    front.shutdown()
+    thread.join(timeout=30)
+    assert not thread.is_alive()
+    assert rc_box.get("rc") == 0  # clean stop, not the preemption exit
+
+
+def _endpoint(fake):
+    return f"127.0.0.1:{fake.port}"
+
+
+def test_routing_and_reply_stamps_across_two_replicas():
+    a, b = FakeReplica(), FakeReplica()
+    front, thread, rc_box = _start_front([_endpoint(a), _endpoint(b)])
+    try:
+        with PolicyClient("127.0.0.1", front.listener.port) as client:
+            pong = client.ping()
+            assert set(pong["fleet"]["replicas"]) == {"static0", "static1"}
+            for _ in range(6):
+                action, meta = client.act(OBS, "m:1")
+                np.testing.assert_array_equal(action, [0])
+                assert meta["replica"] in ("static0", "static1")
+                assert meta["front_ms"] >= 0
+                assert meta["bucket"] == 1  # the replica's stamps ride through
+    finally:
+        _stop_front(front, thread, rc_box)
+    summary = front.summary()
+    assert summary["accepted"] == summary["replied"] == 6
+    assert summary["errors"] == summary["dropped"] == 0
+    assert len(a.acts()) + len(b.acts()) == 6
+
+
+def test_draining_reply_bounces_to_a_live_replica():
+    # static0 pongs healthy but bounces every act — the front must reroute the
+    # bounced request instantly and stop routing there.
+    a, b = FakeReplica(mode="draining"), FakeReplica(action=(7,))
+    front, thread, rc_box = _start_front([_endpoint(a), _endpoint(b)])
+    try:
+        with PolicyClient("127.0.0.1", front.listener.port) as client:
+            for _ in range(3):
+                action, meta = client.act(OBS, "m:1")
+                np.testing.assert_array_equal(action, [7])
+                assert meta["replica"] == "static1"
+    finally:
+        _stop_front(front, thread, rc_box)
+    assert front.rerouted >= 1  # the bounce
+    assert front.summary()["accepted"] == front.summary()["replied"] == 3
+
+
+def test_replica_death_reroutes_in_flight_with_zero_loss():
+    a, b = FakeReplica(), FakeReplica()
+    a.hold.set()  # static0 swallows acts: they stay in flight
+    front, thread, rc_box = _start_front([_endpoint(a), _endpoint(b)])
+    results = {}
+    try:
+        def blocked_client():
+            with PolicyClient("127.0.0.1", front.listener.port) as client:
+                results["blocked"] = client.act(OBS, "m:1", timeout=30)
+
+        t = threading.Thread(target=blocked_client, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while not a.acts():  # the first act landed on static0 (name tiebreak)
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+
+        # a second client routes around the loaded replica
+        with PolicyClient("127.0.0.1", front.listener.port) as client:
+            _, meta = client.act(OBS, "m:1", timeout=10)
+            assert meta["replica"] == "static1"
+
+        a.kill()  # no drain, no goodbye: the held request must be rerouted
+        t.join(timeout=30)
+        assert not t.is_alive(), "in-flight request was lost with its replica"
+        assert results["blocked"][1]["replica"] == "static1"
+    finally:
+        _stop_front(front, thread, rc_box)
+    assert front.rerouted >= 1
+    summary = front.summary()
+    assert summary["accepted"] == summary["replied"] == 2
+    assert summary["errors"] == summary["dropped"] == 0
+
+
+def test_session_affinity_sticks_and_reassigns_on_death():
+    a, b = FakeReplica(), FakeReplica()
+    front, thread, rc_box = _start_front([_endpoint(a), _endpoint(b)])
+    try:
+        with PolicyClient("127.0.0.1", front.listener.port) as client:
+            owners = set()
+            for _ in range(5):
+                _, meta = client.act(OBS, "m:1", session="alice")
+                owners.add(meta["replica"])
+            assert len(owners) == 1  # affine: one owner while it lives
+            owner = owners.pop()
+            served = a if owner == "static0" else b
+            assert all(s == "alice" for _, s, _ in served.acts())
+
+            # reset rides the meta to the replica
+            _, _ = client.act(OBS, "m:1", session="alice", reset=True)
+            assert served.acts()[-1][2] is True
+
+            served.kill()
+            survivor = "static1" if owner == "static0" else "static0"
+            for _ in range(3):
+                _, meta = client.act(OBS, "m:1", session="alice", timeout=10)
+                assert meta["replica"] == survivor  # reassigned, still affine
+    finally:
+        _stop_front(front, thread, rc_box)
+    assert front.summary()["errors"] == 0
+
+
+@pytest.mark.parametrize("canary_action,expect_promote", [((0,), True), ((9,), False)])
+def test_canary_split_shadows_and_agreement_gate(canary_action, expect_promote):
+    incumbent = FakeReplica(action=(0,))
+    canary = FakeReplica(action=canary_action)
+    front, thread, rc_box = _start_front(
+        [_endpoint(incumbent), f"canary@{_endpoint(canary)}"],
+        extra=["serve.fleet.canary.spec=m:2", "serve.fleet.canary.fraction=0.5"],
+    )
+    try:
+        with PolicyClient("127.0.0.1", front.listener.port) as client:
+            actions = [client.act(OBS, "m:1")[0][0] for _ in range(4)]
+        # error diffusion: acts 2 and 4 hit the canary, the client saw its answers
+        assert actions == [0, canary_action[0], 0, canary_action[0]]
+        # every canary-routed act was shadowed on the incumbent
+        deadline = time.monotonic() + 10.0
+        while front.canary.compared < 2:
+            assert time.monotonic() < deadline, front.canary.summary()
+            time.sleep(0.01)
+    finally:
+        _stop_front(front, thread, rc_box)
+    assert len(canary.acts()) == 2
+    assert len(incumbent.acts()) == 4  # 2 direct + 2 shadows
+    stamp = front.summary()["canary"]
+    assert stamp["spec"] == "m:2" and stamp["routed"] == stamp["compared"] == 2
+    assert stamp["agreement"] == (1.0 if expect_promote else 0.0)
+    assert stamp["promote"] is expect_promote
+    # the canary never takes normal traffic: the 2 direct acts went incumbent-side
+    assert front.summary()["accepted"] == front.summary()["replied"] == 4
+
+
+def test_requests_park_until_a_replica_is_admitted(tmp_path):
+    front, thread, rc_box = _start_front([], extra=[f"serve.fleet.dir={tmp_path}"])
+    result = {}
+    try:
+        def patient_client():
+            with PolicyClient("127.0.0.1", front.listener.port) as client:
+                result["reply"] = client.act(OBS, "m:1", timeout=30)
+
+        t = threading.Thread(target=patient_client, daemon=True)
+        t.start()
+        time.sleep(0.3)  # the act is parked: no replica exists yet
+        assert "reply" not in result
+
+        fake = FakeReplica(action=(3,))
+        record = {"name": "replica0", "host": "127.0.0.1", "port": fake.port,
+                  "canary": False, "generation": 0, "pid": 4242}
+        records_dir = tmp_path / "replicas"
+        records_dir.mkdir(exist_ok=True)
+        (records_dir / "replica0.json").write_text(json.dumps(record))
+
+        t.join(timeout=30)  # discovery admits the record, the parked act flushes
+        assert not t.is_alive(), "parked request never routed"
+        np.testing.assert_array_equal(result["reply"][0], [3])
+        assert result["reply"][1]["replica"] == "replica0"
+
+        # the periodic status file catches up with the admission
+        deadline = time.monotonic() + 5.0
+        while True:
+            status = json.loads((tmp_path / "front_status.json").read_text())
+            if "replica0" in status["replicas"] or time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+        assert status["replicas"]["replica0"]["queue_depth"] == 0
+    finally:
+        _stop_front(front, thread, rc_box)
+    assert front.replicas_admitted == 1
+    assert front.summary()["accepted"] == front.summary()["replied"] == 1
+
+
+# ------------------------------------------------------------- FleetClient
+def test_fleet_client_fails_over_to_a_live_endpoint():
+    dead = Listener(host="127.0.0.1", port=0)
+    dead_port = dead.port
+    dead.close()
+    live = FakeReplica(action=(5,))
+    with FleetClient(
+        [("127.0.0.1", dead_port), ("127.0.0.1", live.port)],
+        timeout_s=2.0, backoff_s=0.01, backoff_max_s=0.02,
+    ) as fc:
+        action, _ = fc.act(OBS, "m:1")
+        np.testing.assert_array_equal(action, [5])
+        assert fc.failovers >= 1 and fc.retries >= 1
+        assert fc.ping()["policies"] == ["m:1"]
+
+
+def test_fleet_client_rotates_off_a_draining_endpoint():
+    draining = FakeReplica(mode="draining")
+    live = FakeReplica(action=(8,))
+    with FleetClient(
+        [_endpoint(draining), _endpoint(live)], backoff_s=0.01, backoff_max_s=0.02
+    ) as fc:
+        action, _ = fc.act(OBS, "m:1")
+        np.testing.assert_array_equal(action, [8])
+        assert fc.failovers == 1
+
+
+def test_fleet_client_bounded_retries_then_raises():
+    dead = Listener(host="127.0.0.1", port=0)
+    dead_port = dead.port
+    dead.close()
+    with FleetClient(
+        [("127.0.0.1", dead_port)], timeout_s=1.0, max_attempts=3,
+        backoff_s=0.01, backoff_max_s=0.02,
+    ) as fc:
+        with pytest.raises(ConnectionError, match="after 3 attempts"):
+            fc.act(OBS, "m:1")
+        assert fc.retries == 3

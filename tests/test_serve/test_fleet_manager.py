@@ -1,0 +1,183 @@
+"""FleetManager process-lifecycle policy with fake child processes: death
+classification (crash backoff / preemption / retirement), ready -> record
+publication, and autoscale decisions.  No real subprocesses, no JAX."""
+
+import json
+import signal
+import time
+
+import pytest
+
+from sheeprl_tpu.fault.preemption import RESUMABLE_EXIT_CODE
+from sheeprl_tpu.serve.fleet.manager import FleetManager
+
+
+class FakeProc:
+    def __init__(self, rc=None, pid=4242):
+        self.returncode = rc  # None = still running
+        self.pid = pid
+        self.signals = []
+
+    def poll(self):
+        return self.returncode
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+    def kill(self):
+        if self.returncode is None:
+            self.returncode = -9
+
+
+@pytest.fixture
+def manager(tmp_path):
+    from sheeprl_tpu.config.core import compose
+
+    overrides = [
+        "serve.fleet.enabled=True",
+        f"serve.fleet.dir={tmp_path}",
+        "serve.fleet.min_replicas=1",
+        "serve.fleet.max_replicas=2",
+        "serve.fleet.scale_up_queue_depth=4.0",
+        "serve.fleet.scale_up_after_s=0.0",
+        "serve.fleet.scale_down_after_s=0.0",
+        "serve.fleet.scale_cooldown_s=0.0",
+        "fault.max_retries=2",
+        "fault.backoff_s=2.0",
+        "fault.backoff_max_s=60.0",
+    ]
+    cfg = compose(config_name="serve_cli", overrides=overrides)
+    mgr = FleetManager(overrides, cfg)
+    mgr.spawned = []
+
+    def fake_spawn(slot):
+        mgr.spawned.append(slot.name)
+        slot.proc = FakeProc()
+        slot.ready_recorded = False
+
+    mgr._spawn = fake_spawn
+    return mgr
+
+
+def _running_replica(mgr, name="replica0", index=0):
+    slot = mgr._make_slot(name, index, "replica")
+    slot.proc = FakeProc()
+    return slot
+
+
+def test_crash_consumes_retry_budget_with_exponential_backoff(manager):
+    slot = _running_replica(manager)
+    slot.record_path.write_text("{}")  # the front's admission record
+
+    slot.proc.returncode = 1
+    t0 = time.monotonic()
+    assert manager._reap() is None
+    assert (slot.retries, slot.consecutive, slot.generation) == (1, 1, 1)
+    assert not slot.record_path.exists()  # the dead replica is de-published
+    assert slot.proc is None
+    assert slot.next_spawn_at - t0 == pytest.approx(2.0, abs=0.5)  # base backoff
+    manager._respawn_due()
+    assert manager.spawned == []  # backoff holds the respawn
+
+    slot.proc = FakeProc(rc=1)
+    assert manager._reap() is None
+    assert (slot.retries, slot.consecutive) == (2, 2)
+    assert slot.next_spawn_at - time.monotonic() == pytest.approx(4.0, abs=0.5)  # doubled
+
+    # third crash exceeds fault.max_retries=2; the lone replica slot is
+    # abandoned, so the whole fleet gives up
+    slot.proc = FakeProc(rc=1)
+    assert manager._reap() == 1
+    assert slot.abandoned is True
+    assert manager.summary["outcome"] == "retry_budget"
+
+
+def test_preemption_respawns_immediately_and_resets_the_backoff_clock(manager):
+    slot = _running_replica(manager)
+    slot.proc.returncode = 1
+    assert manager._reap() is None
+    assert slot.consecutive == 1
+
+    slot.proc = FakeProc(rc=RESUMABLE_EXIT_CODE)
+    assert manager._reap() is None
+    assert slot.preemptions == 1
+    assert slot.consecutive == 0  # a clean drain proves the binary healthy
+    assert slot.retries == 1  # preemptions never consume the crash budget
+    assert slot.next_spawn_at == 0.0
+    manager._respawn_due()
+    assert manager.spawned == ["replica0"]  # respawned with no delay
+    assert slot.generation == 2
+
+
+def test_scaled_down_slot_retires_instead_of_respawning(manager):
+    slot = _running_replica(manager)
+    slot.desired = False  # the autoscaler's drain request
+    slot.proc.returncode = RESUMABLE_EXIT_CODE
+    assert manager._reap() is None
+    assert "replica0" not in manager.slots
+    manager._respawn_due()
+    assert manager.spawned == []
+
+
+def test_front_clean_exit_stops_the_fleet(manager):
+    front = manager._make_slot("front", 0, "front")
+    front.proc = FakeProc(rc=0)
+    assert manager._reap() == 0
+    assert manager.summary["outcome"] == "clean"
+
+
+def test_ready_file_becomes_the_admission_record(manager):
+    slot = _running_replica(manager)
+    slot.ready_file.write_text(json.dumps({"host": "127.0.0.1", "port": 7001}))
+    manager._check_ready()
+    assert slot.ready_recorded is True
+    record = json.loads(slot.record_path.read_text())
+    assert record == {
+        "name": "replica0",
+        "host": "127.0.0.1",
+        "port": 7001,
+        "canary": False,
+        "generation": 0,
+        "pid": slot.proc.pid,
+    }
+
+
+def test_autoscaler_spawns_on_load_and_drains_the_highest_index_on_idle(manager, tmp_path):
+    slot = _running_replica(manager)
+    slot.ready_recorded = True
+
+    (tmp_path / "front_status.json").write_text(json.dumps({"pending": 50.0}))
+    deadline = time.monotonic() + 5.0
+    while manager.summary["scale_ups"] == 0 and time.monotonic() < deadline:
+        manager._autoscale()
+        time.sleep(0.02)
+    assert manager.summary["scale_ups"] == 1
+    assert manager.spawned == ["replica1"]
+    assert "replica1" in manager.slots
+
+    # hot forever at max_replicas=2: never a third
+    manager.slots["replica1"].ready_recorded = True
+    for _ in range(5):
+        manager._autoscale()
+        time.sleep(0.02)
+    assert manager.summary["scale_ups"] == 1
+
+    (tmp_path / "front_status.json").write_text(json.dumps({"pending": 0.0}))
+    deadline = time.monotonic() + 5.0
+    while manager.summary["scale_downs"] == 0 and time.monotonic() < deadline:
+        manager._autoscale()
+        time.sleep(0.02)
+    assert manager.summary["scale_downs"] == 1
+    victim = manager.slots["replica1"]
+    assert victim.desired is False  # drained, not respawned
+    assert victim.proc.signals == [signal.SIGTERM]
+
+    # idle forever at min_replicas=1: the last replica is never drained
+    for _ in range(5):
+        manager._autoscale()
+        time.sleep(0.02)
+    assert manager.summary["scale_downs"] == 1
+    assert manager.slots["replica0"].desired is True

@@ -1,0 +1,193 @@
+"""serve/fleet primitives: least-loaded pick, session-affine ring, autoscaler
+hysteresis, canary accounting.  All pure — no sockets, no processes."""
+
+import math
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.fleet.autoscale import AutoscaleDecider
+from sheeprl_tpu.serve.fleet.canary import CanaryTracker, rows_agree
+from sheeprl_tpu.serve.fleet.routing import HashRing, ReplicaLoad, pick_replica, routable
+
+
+# ------------------------------------------------------------- least-loaded
+def test_pick_replica_least_loaded_and_exclusions():
+    loads = {
+        "a": ReplicaLoad(inflight=3),
+        "b": ReplicaLoad(inflight=1, queue_depth=1.0),
+        "c": ReplicaLoad(inflight=1, queue_depth=3.0),
+    }
+    assert pick_replica(loads) == "b"  # score = inflight + queue_depth
+    assert pick_replica(loads, exclude=("b",)) == "a"
+    assert pick_replica(loads, exclude=("a", "b", "c")) is None
+    assert pick_replica({}) is None
+
+
+def test_pick_replica_skips_draining_and_dead():
+    loads = {
+        "idle_but_draining": ReplicaLoad(inflight=0, draining=True),
+        "idle_but_dead": ReplicaLoad(inflight=0, alive=False),
+        "busy": ReplicaLoad(inflight=9),
+    }
+    assert not routable(loads["idle_but_draining"])
+    assert not routable(loads["idle_but_dead"])
+    assert pick_replica(loads) == "busy"
+    loads["busy"].draining = True
+    assert pick_replica(loads) is None
+
+
+def test_pick_replica_ties_break_on_p99_then_name():
+    loads = {
+        "slow": ReplicaLoad(inflight=1, p99_ms=40.0),
+        "fast": ReplicaLoad(inflight=1, p99_ms=5.0),
+    }
+    assert pick_replica(loads) == "fast"
+    # NaN p99 (no reply stamp seen yet) sorts AFTER any measured p99...
+    loads["unknown"] = ReplicaLoad(inflight=1, p99_ms=math.nan)
+    assert pick_replica(loads) == "fast"
+    # ...and two unknowns fall back to the name for determinism.
+    only_nan = {"b": ReplicaLoad(p99_ms=math.nan), "a": ReplicaLoad(p99_ms=math.nan)}
+    assert pick_replica(only_nan) == "a"
+
+
+# ---------------------------------------------------------- consistent hash
+def test_hash_ring_assignment_is_stable():
+    ring = HashRing()
+    for member in ("replica0", "replica1", "replica2"):
+        ring.add(member)
+    sessions = [f"client{i}" for i in range(200)]
+    first = {s: ring.assign(s) for s in sessions}
+    # stable across repeated lookups
+    assert all(ring.assign(s) == first[s] for s in sessions)
+    # stable across an independently-built ring (pure function of the labels)
+    other = HashRing()
+    for member in ("replica2", "replica0", "replica1"):  # insertion order irrelevant
+        other.add(member)
+    assert all(other.assign(s) == first[s] for s in sessions)
+    # every member owns a share (vnodes keep it roughly balanced)
+    owners = set(first.values())
+    assert owners == {"replica0", "replica1", "replica2"}
+
+
+def test_hash_ring_death_reassigns_only_the_dead_members_sessions():
+    ring = HashRing()
+    for member in ("replica0", "replica1", "replica2"):
+        ring.add(member)
+    sessions = [f"client{i}" for i in range(300)]
+    before = {s: ring.assign(s) for s in sessions}
+    ring.remove("replica1")
+    assert "replica1" not in ring
+    after = {s: ring.assign(s) for s in sessions}
+    for s in sessions:
+        if before[s] == "replica1":
+            assert after[s] in ("replica0", "replica2")  # reassigned somewhere live
+        else:
+            assert after[s] == before[s]  # survivors keep every session
+
+
+def test_hash_ring_add_steals_minimally_and_empty_ring():
+    ring = HashRing()
+    assert ring.assign("anyone") is None
+    ring.add("replica0")
+    ring.add("replica1")
+    sessions = [f"client{i}" for i in range(300)]
+    before = {s: ring.assign(s) for s in sessions}
+    ring.add("replica2")
+    after = {s: ring.assign(s) for s in sessions}
+    moved = [s for s in sessions if after[s] != before[s]]
+    # only sessions stolen BY the newcomer move — nobody shuffles between survivors
+    assert all(after[s] == "replica2" for s in moved)
+    assert 0 < len(moved) < len(sessions)
+    assert ring.members() == ["replica0", "replica1", "replica2"]
+
+
+# -------------------------------------------------------------- autoscaler
+def test_autoscaler_scales_up_only_on_sustained_load():
+    d = AutoscaleDecider(scale_up_queue_depth=4.0, scale_up_after_s=3.0, cooldown_s=5.0)
+    assert d.decide(0.0, live=1, pending=8.0) is None  # hot, clock starts
+    assert d.decide(2.0, live=1, pending=8.0) is None  # not sustained yet
+    assert d.decide(3.5, live=1, pending=8.0) == "up"  # 3.5s >= 3.0s sustained
+    # a spike that dips resets the clock — no flapping on bursty load
+    d = AutoscaleDecider(scale_up_queue_depth=4.0, scale_up_after_s=3.0)
+    assert d.decide(0.0, live=1, pending=8.0) is None
+    assert d.decide(2.0, live=1, pending=1.0) is None  # dead zone: clock resets
+    assert d.decide(3.5, live=1, pending=8.0) is None  # hot again, fresh clock
+    assert d.decide(7.0, live=1, pending=8.0) == "up"
+
+
+def test_autoscaler_scales_down_on_sustained_idle_and_respects_bounds():
+    d = AutoscaleDecider(min_replicas=1, max_replicas=2, scale_down_after_s=10.0, cooldown_s=0.0)
+    assert d.decide(0.0, live=2, pending=0.0) is None
+    assert d.decide(9.0, live=2, pending=0.0) is None
+    assert d.decide(10.5, live=2, pending=0.0) == "down"
+    # at the floor: idle forever never drops below min_replicas
+    d = AutoscaleDecider(min_replicas=1, scale_down_after_s=1.0, cooldown_s=0.0)
+    assert d.decide(0.0, live=1, pending=0.0) is None
+    assert d.decide(100.0, live=1, pending=0.0) is None
+    # at the ceiling: hot forever never grows past max_replicas
+    d = AutoscaleDecider(max_replicas=2, scale_up_after_s=1.0, cooldown_s=0.0)
+    assert d.decide(0.0, live=2, pending=99.0) is None
+    assert d.decide(100.0, live=2, pending=99.0) is None
+
+
+def test_autoscaler_cooldown_blocks_back_to_back_decisions():
+    d = AutoscaleDecider(
+        max_replicas=4, scale_up_queue_depth=4.0, scale_up_after_s=1.0, cooldown_s=5.0
+    )
+    assert d.decide(0.0, live=1, pending=50.0) is None
+    assert d.decide(1.5, live=1, pending=50.0) == "up"
+    # still hot, but the fresh replica needs time to absorb load first
+    assert d.decide(2.0, live=2, pending=50.0) is None
+    assert d.decide(4.0, live=2, pending=50.0) is None
+    assert d.decide(8.0, live=2, pending=50.0) == "up"  # cooldown over, load sustained
+
+
+# ------------------------------------------------------------------ canary
+def test_canary_error_diffusion_routes_exact_fraction():
+    tracker = CanaryTracker("m:2", fraction=0.25)
+    taken = [tracker.take() for _ in range(100)]
+    assert sum(taken) == 25  # exactly round(n * fraction), not approximately
+    assert tracker.routed == 25
+    # the pattern is maximally spread (every 4th request), not front-loaded
+    assert taken[:8] == [False, False, False, True] * 2
+
+    assert not any(CanaryTracker("m:2", fraction=0.0).take() for _ in range(10))
+
+
+def test_canary_agreement_gate():
+    tracker = CanaryTracker("m:2", fraction=0.5, min_agreement=0.99)
+    assert math.isnan(tracker.agreement)
+    assert tracker.promote is False  # no comparisons -> no promotion
+    for _ in range(99):
+        tracker.record(np.array([1, 0]), np.array([1, 0]))
+    assert tracker.promote is True
+    tracker.record(np.array([1, 0]), np.array([0, 1]))  # one disagreement at n=100
+    assert tracker.agreement == pytest.approx(0.99)
+    assert tracker.promote is True
+    tracker.record(np.array([1, 0]), np.array([0, 1]))
+    assert tracker.promote is False  # dipped below the gate: not promoted
+    s = tracker.summary()
+    assert s["compared"] == 101 and s["promote"] is False
+    assert s["agreement"] == pytest.approx(99 / 101)
+
+
+def test_rows_agree_matches_precision_parity_semantics():
+    """The front's numpy-only re-implementation must agree with PR-15's
+    ``action_agreement`` (which the router cannot import: it pulls in JAX)."""
+    from sheeprl_tpu.precision.parity import action_agreement
+
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        # discrete: multi-head action index rows
+        a = rng.integers(0, 3, size=(2,))
+        b = a.copy() if rng.random() < 0.5 else rng.integers(0, 3, size=(2,))
+        assert rows_agree(a, b) == (action_agreement(a[None], b[None]) == 1.0)
+        # continuous: per-component atol
+        x = rng.normal(size=(4,)).astype(np.float32)
+        y = x + rng.choice([0.0, 5e-3, 5e-2]) * rng.choice([-1.0, 1.0])
+        assert rows_agree(x, y, atol=1e-2) == (
+            action_agreement(x[None], y[None], continuous=True, atol=1e-2) == 1.0
+        )
+    # shape mismatch can never agree
+    assert not rows_agree(np.zeros(2), np.zeros(3))

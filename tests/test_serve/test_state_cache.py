@@ -1,0 +1,96 @@
+"""serve/state_cache.py: session -> device-row mapping for stateful policies."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.state_cache import SessionStateCache
+
+
+def _zero_state(n):
+    import jax.numpy as jnp
+
+    return {"h": jnp.zeros((n, 3), jnp.float32), "prev": jnp.zeros((n, 2), jnp.float32)}
+
+
+@pytest.fixture
+def cache():
+    return SessionStateCache(_zero_state, capacity=3)
+
+
+def test_new_session_starts_fresh_and_then_continues(cache):
+    idx, is_first = cache.assign(["alice"], [False])
+    assert is_first[0, 0] == 1.0  # never seen: episode start regardless of reset
+    row = int(idx[0])
+    assert 0 <= row < cache.capacity
+
+    idx2, is_first2 = cache.assign(["alice"], [False])
+    assert int(idx2[0]) == row  # same session -> same device row
+    assert is_first2[0, 0] == 0.0  # continuing the episode
+
+    idx3, is_first3 = cache.assign(["alice"], [True])  # explicit episode restart
+    assert int(idx3[0]) == row
+    assert is_first3[0, 0] == 1.0
+
+
+def test_sessionless_requests_ride_the_scratch_row(cache):
+    idx, is_first = cache.assign([None, "bob", None], [False, False, False])
+    assert int(idx[0]) == int(idx[2]) == cache.scratch
+    assert is_first[0, 0] == is_first[2, 0] == 1.0
+    assert int(idx[1]) != cache.scratch
+    assert len(cache) == 1  # scratch traffic never occupies a session slot
+
+
+def test_lru_eviction_and_returning_session_restarts(cache):
+    for name in ("s0", "s1", "s2"):
+        cache.assign([name], [False])
+    cache.assign(["s0"], [False])  # refresh s0: s1 becomes the LRU
+    idx_new, _ = cache.assign(["s3"], [False])  # full: evicts s1
+    assert cache.evictions == 1
+    assert len(cache) == 3
+
+    # the evicted session coming back gets a fresh episode, not s3's state
+    idx_back, is_first = cache.assign(["s1"], [False])
+    assert is_first[0, 0] == 1.0
+    assert cache.evictions == 2  # s1's return evicted the next LRU (s2)
+    # the refreshed session was protected throughout
+    idx_s0, is_first_s0 = cache.assign(["s0"], [False])
+    assert is_first_s0[0, 0] == 0.0
+
+
+def test_drop_frees_the_slot(cache):
+    idx, _ = cache.assign(["alice"], [False])
+    cache.drop("alice")
+    assert len(cache) == 0
+    idx2, is_first = cache.assign(["alice"], [False])
+    assert is_first[0, 0] == 1.0  # dropped session restarts
+    cache.drop("ghost")  # unknown session: no-op
+
+
+def test_gather_scatter_roundtrip_and_padding_isolation(cache):
+    idx, _ = cache.assign(["alice", "bob"], [False, False])
+    # pad to a bucket of 4 the way the server does: scratch rows
+    idx_p = np.full((4,), cache.scratch, np.int32)
+    idx_p[:2] = idx
+    rows = cache.gather(idx_p)
+    assert rows["h"].shape == (4, 3)
+
+    new_rows = {
+        "h": np.arange(12, dtype=np.float32).reshape(4, 3),
+        "prev": np.ones((4, 2), np.float32),
+    }
+    cache.scatter(idx_p, new_rows)
+    # real sessions persisted their rows...
+    got = np.asarray(cache.gather(idx_p)["h"])
+    np.testing.assert_array_equal(got[:2], new_rows["h"][:2])
+    # ...and padding rows only touched scratch — session slots are untouched
+    storage_h = np.asarray(cache.storage["h"])
+    untouched = [r for r in range(cache.capacity) if r not in set(int(i) for i in idx)]
+    for r in untouched:
+        np.testing.assert_array_equal(storage_h[r], np.zeros(3, np.float32))
+
+
+def test_warmup_traces_every_bucket_and_stats(cache):
+    cache.warmup([1, 2, 4, 4])
+    cache.assign(["alice"], [False])
+    stats = cache.stats()
+    assert stats == {"capacity": 3, "sessions": 1, "evictions": 0}

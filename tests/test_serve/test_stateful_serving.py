@@ -1,0 +1,108 @@
+"""Stateful (recurrent) policy serving: the replica keeps per-session act
+state device-resident (``serve/state_cache.py``) so a session-affine client
+just sends observations — no state round-trips — and dispatches stay
+recompile-free across sessions, resets and batch shapes."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.client import PolicyClient
+
+MODEL = "serve_test_rppo"
+
+TINY_RECURRENT = [
+    "exp=ppo_recurrent",
+    "env=jax_cartpole",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.encoder.mlp_features_dim=8",
+    "algo.rnn.lstm.hidden_size=8",
+    "env.num_envs=1",
+    "env.capture_video=False",
+]
+
+
+@pytest.fixture(scope="module")
+def registry(tmp_path_factory):
+    import jax
+
+    from sheeprl_tpu.checkpoint.manager import CheckpointManager
+    from sheeprl_tpu.config.core import compose, save_config
+    from sheeprl_tpu.parallel.mesh import MeshContext, build_mesh
+    from sheeprl_tpu.utils.env import make_env
+    from sheeprl_tpu.utils.model_manager import LocalModelManager
+    from sheeprl_tpu.utils.policy import build_policy
+
+    tmp = tmp_path_factory.mktemp("rppo_registry")
+    cfg = compose(config_name="config", overrides=TINY_RECURRENT)
+    env = make_env(cfg, 0, 0, None, "rppo_test")()
+    ctx = MeshContext(mesh=build_mesh(devices=jax.devices()[:1]), precision="fp32", seed=0)
+    policy, params = build_policy(ctx, cfg, env.observation_space, env.action_space)
+    env.close()
+
+    ckpt = CheckpointManager(tmp / "run" / "checkpoints").save(0, {"params": params})
+    save_config(cfg, tmp / "run" / "config.yaml")
+    mm = LocalModelManager(registry_dir=tmp / "registry")
+    mm.register_model(str(ckpt), MODEL)
+    return tmp / "registry", policy.obs_template
+
+
+def test_recurrent_policy_serves_sessions_without_recompiles(registry):
+    registry_dir, obs_template = registry
+    from sheeprl_tpu.config.core import compose
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    cfg = compose(
+        config_name="serve_cli",
+        overrides=[
+            f"serve.policies=[{MODEL}:1]",
+            f"model_manager.registry_dir={registry_dir}",
+            "serve.host=127.0.0.1",
+            "serve.port=0",
+            "serve.max_batch_size=4",
+            "serve.max_batch_delay_ms=2.0",
+            "serve.session_capacity=8",
+            "serve.log_every_s=0",
+            "analysis.strict=True",  # any dispatch-time recompile raises
+        ],
+    )
+    server = PolicyServer(cfg)
+    ep = server.endpoints[f"{MODEL}:1"]
+    assert ep.policy.stateful is True
+    assert ep.state_cache is not None  # warmed at startup alongside the ladder
+
+    rc_box = {}
+    thread = threading.Thread(target=lambda: rc_box.update(rc=server.run()), daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 30.0
+    while server.listener is None:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+
+    obs = {k: np.zeros(shape, dtype=np.dtype(dtype)) for k, (shape, dtype) in obs_template.items()}
+    try:
+        with PolicyClient("127.0.0.1", server.listener.port) as client:
+            # three interleaved sessions plus session-less traffic, mixed into
+            # shared batches; all buckets and the reset path get exercised
+            for step in range(6):
+                for session in ("alice", "bob", "carol"):
+                    action, meta = client.act(obs, MODEL, session=session)
+                    assert action.shape == (len(ep.policy.action_dims),)
+                    assert meta["bucket"] in ep.ladder
+            client.act(obs, MODEL)  # stateless rider on the scratch row
+            client.act(obs, MODEL, session="alice", reset=True)  # episode restart
+    finally:
+        server.shutdown()
+        thread.join(timeout=30)
+
+    assert rc_box.get("rc") == 0
+    summary = server.summary()
+    assert summary["accepted"] == summary["replied"] == 6 * 3 + 2
+    assert summary["recompiles"] == 0  # sessions/resets never re-trace
+    sessions = summary["policies"][f"{MODEL}:1"]["sessions"]
+    assert sessions == {"capacity": 8, "sessions": 3, "evictions": 0}
