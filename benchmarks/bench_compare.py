@@ -52,6 +52,7 @@ _LOWER_BETTER_METRICS = (
     "checkpoint_save_seconds",
     "fleet_p99_ms",
     "obs_fleet_overhead_pct",
+    "race_detect_overhead_pct",
     "resume_restore_seconds",
     "serve_p99_ms",
     "serve_startup_seconds",

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Race-detector overhead: what does lock instrumentation cost a threaded loop?
+
+The jaxlint-threads runtime detector (``sheeprl_tpu/analysis/threads/runtime.py``)
+promises observation-only semantics at one bookkeeping dict hit per nested
+acquisition.  This bench A/Bs a sebulba-shaped producer/consumer workload —
+N producer threads feeding a bounded ``queue.Queue`` with a lock-guarded stats
+counter, exactly the publish/consume bookkeeping shape — with the real
+``threading`` factories vs the detector globally installed (so the queue's
+*internal* condition locks are instrumented too, which is what a real
+``SHEEPRL_TPU_RACE_DETECT=1`` run pays):
+
+    overhead_pct = (wall_instrumented - wall_bare) / wall_bare * 100
+
+Emits one BENCH-style JSON row, ``race_detect_overhead_pct`` — direction-pinned
+lower-better by exact name in ``benchmarks/bench_compare.py``.  Runs as part of
+``benchmarks/sebulba_bench.py`` unless ``BENCH_RACE=0``.
+
+Usage::
+
+    python benchmarks/race_detect_bench.py [--items 20000] [--threads 4] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _spin(work_s: float) -> None:
+    """Busy-spin stand-in for per-item work (env stepping / block processing) —
+    without it the workload is ~100% lock operations and the row measures raw
+    wrapper cost instead of what a real run pays."""
+    deadline = time.perf_counter() + work_s
+    while time.perf_counter() < deadline:
+        pass
+
+
+def _workload(items_per_thread: int, n_threads: int, work_s: float) -> None:
+    """Producer/consumer round trip: the locks and queue are constructed INSIDE
+    the measured region so the currently-installed factories apply."""
+    q: "queue.Queue[int]" = queue.Queue(maxsize=64)
+    lock = threading.Lock()
+    stats = {"produced": 0, "consumed": 0}
+
+    def producer() -> None:
+        for i in range(items_per_thread):
+            _spin(work_s)
+            q.put(i)
+            with lock:
+                stats["produced"] += 1
+
+    def consumer() -> None:
+        for _ in range(items_per_thread * n_threads):
+            q.get()
+            _spin(work_s)
+            with lock:
+                stats["consumed"] += 1
+
+    threads = [threading.Thread(target=producer) for _ in range(n_threads)]
+    threads.append(threading.Thread(target=consumer))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert stats["produced"] == stats["consumed"] == items_per_thread * n_threads
+
+
+def _measure(items_per_thread: int, n_threads: int, work_s: float) -> float:
+    t0 = time.perf_counter()
+    _workload(items_per_thread, n_threads, work_s)
+    return time.perf_counter() - t0
+
+
+def run_bench(items: int = 20000, n_threads: int = 4, repeats: int = 3, work_us: float = 50.0) -> dict:
+    from sheeprl_tpu.analysis.threads import runtime as race_runtime
+
+    items_per_thread = max(items // n_threads, 1)
+    work_s = work_us / 1e6
+    detector = race_runtime.RaceDetector(held_threshold_ms=0.0)  # no long-hold noise
+    bare: List[float] = []
+    inst: List[float] = []
+    _measure(items_per_thread // 4 or 1, n_threads, work_s)  # warmup: threads + allocator
+    try:
+        for _ in range(repeats):  # interleave so drift hits both arms equally
+            bare.append(_measure(items_per_thread, n_threads, work_s))
+            race_runtime.install(detector)
+            try:
+                inst.append(_measure(items_per_thread, n_threads, work_s))
+            finally:
+                race_runtime.uninstall()
+    finally:
+        race_runtime.uninstall()
+    overhead = (min(inst) - min(bare)) / min(bare) * 100.0
+    counts = detector.counts()
+    return {
+        "metric": "race_detect_overhead_pct",
+        "value": round(max(overhead, 0.0), 3),
+        "unit": (
+            f"% wall-time overhead (lower is better; {n_threads} producers + 1 consumer, "
+            f"{items_per_thread * n_threads} queue round trips at ~{work_us:.0f}us work/item, "
+            f"best-of-{repeats}, detector globally installed vs real threading factories)"
+        ),
+        "acquisitions": counts["acquisitions"],
+        "edges": counts["edges"],
+        "cycles": counts["cycles"],
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--items", type=int, default=int(os.environ.get("BENCH_RACE_ITEMS", "20000")))
+    parser.add_argument("--threads", type=int, default=4)
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument(
+        "--work-us", type=float, default=float(os.environ.get("BENCH_RACE_WORK_US", "50"))
+    )
+    args = parser.parse_args(argv)
+    print(
+        json.dumps(
+            run_bench(
+                items=args.items, n_threads=args.threads, repeats=args.repeats, work_us=args.work_us
+            )
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -186,6 +186,14 @@ def main(argv: Optional[List[str]] = None) -> None:
 
         print(json.dumps(_obs_run_bench()))
 
+    # Race-detector overhead rides along too (BENCH_RACE=0 skips it): the
+    # jaxlint-threads runtime half instrumented over a producer/consumer
+    # queue workload, detector-on vs detector-off.
+    if os.environ.get("BENCH_RACE", "1") != "0":
+        from race_detect_bench import run_bench as _race_run_bench
+
+        print(json.dumps(_race_run_bench()))
+
 
 if __name__ == "__main__":
     main()

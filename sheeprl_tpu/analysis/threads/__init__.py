@@ -1,0 +1,64 @@
+"""jaxlint-threads: the concurrency analysis tier.
+
+Static rules (AST, same Finding/baseline/suppression contract as jaxlint):
+
+| ID    | name                              | catches                                        |
+|-------|-----------------------------------|------------------------------------------------|
+| JL008 | unguarded-shared-mutation         | attr written from a thread body and another    |
+|       |                                   | method with no common lock held                |
+| JL009 | lock-order-inversion              | cycles in the static lock-acquisition graph    |
+|       |                                   | (nested ``with`` + cross-method call edges)    |
+| JL010 | blocking-call-under-lock          | socket/channel I/O, device_get /               |
+|       |                                   | block_until_ready, blocking queue get/put,     |
+|       |                                   | subprocess waits, sleep inside a held lock     |
+| JL011 | thread-lifecycle                  | non-daemon thread never joined; start in       |
+|       |                                   | __init__ before dependent attrs; unstoppable   |
+|       |                                   | ``while True`` thread loop                     |
+| JL012 | condition-wait-no-predicate-loop  | ``Condition.wait()`` not re-checked in a while |
+
+The runtime half lives in :mod:`sheeprl_tpu.analysis.threads.runtime`: an
+opt-in instrumented-lock layer (``analysis.race_detect=True`` /
+``SHEEPRL_TPU_RACE_DETECT=1``) that observes the *dynamic* lock-order graph
+and dumps a JSONL race report into ``<log_dir>/races/``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from sheeprl_tpu.analysis.engine import Rule
+from sheeprl_tpu.analysis.threads.jl008_shared_mutation import UnguardedSharedMutation
+from sheeprl_tpu.analysis.threads.jl009_lock_order import LockOrderInversion
+from sheeprl_tpu.analysis.threads.jl010_blocking_under_lock import BlockingCallUnderLock
+from sheeprl_tpu.analysis.threads.jl011_thread_lifecycle import ThreadLifecycle
+from sheeprl_tpu.analysis.threads.jl012_condition_wait import ConditionWaitWithoutLoop
+
+_RULE_CLASSES = [
+    UnguardedSharedMutation,
+    LockOrderInversion,
+    BlockingCallUnderLock,
+    ThreadLifecycle,
+    ConditionWaitWithoutLoop,
+]
+
+
+def default_thread_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instantiate the concurrency rule set, optionally restricted by id."""
+    rules = [cls() for cls in _RULE_CLASSES]
+    if select:
+        wanted = {s.strip().upper() for s in select}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}; known: {[r.id for r in rules]}")
+        rules = [r for r in rules if r.id in wanted]
+    return rules
+
+
+__all__ = [
+    "default_thread_rules",
+    "UnguardedSharedMutation",
+    "LockOrderInversion",
+    "BlockingCallUnderLock",
+    "ThreadLifecycle",
+    "ConditionWaitWithoutLoop",
+]

@@ -1,0 +1,65 @@
+"""``python -m sheeprl_tpu.analysis.threads [paths...]`` — the jaxlint-threads CLI.
+
+Exit status: 0 when no findings survive the baseline, 1 otherwise, 2 on usage
+errors — same contract as jaxlint/jaxlint-ir.
+
+    python -m sheeprl_tpu.analysis.threads sheeprl_tpu/        # vs threads.baseline
+    python -m sheeprl_tpu.analysis.threads --no-baseline src/  # everything
+    python -m sheeprl_tpu.analysis.threads --write-baseline sheeprl_tpu/
+    python -m sheeprl_tpu.analysis.threads --select JL009 sheeprl_tpu/
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from sheeprl_tpu.analysis.engine import load_baseline, run_lint, write_baseline
+from sheeprl_tpu.analysis.threads import default_thread_rules
+
+DEFAULT_BASELINE = "threads.baseline"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis.threads",
+        description="jaxlint-threads: concurrency static analysis (rules JL008-JL012) for sheeprl-tpu.",
+    )
+    parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files or directories to lint")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE, help="baseline file of accepted fingerprints")
+    parser.add_argument("--no-baseline", action="store_true", help="ignore the baseline entirely")
+    parser.add_argument(
+        "--write-baseline", action="store_true", help="write all current findings to the baseline and exit 0"
+    )
+    parser.add_argument("--select", default=None, help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--root", default=".", help="directory paths are reported relative to")
+    parser.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    args = parser.parse_args(argv)
+
+    try:
+        rules = default_thread_rules(args.select.split(",")) if args.select else default_thread_rules()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    baseline = None if (args.no_baseline or args.write_baseline) else load_baseline(args.baseline)
+    findings = run_lint(args.paths, rules=rules, baseline=baseline, root=args.root)
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        if not args.quiet:
+            print(f"jaxlint-threads: wrote {len(findings)} fingerprint(s) to {args.baseline}")
+        return 0
+
+    for f in findings:
+        print(f.render())
+    if not args.quiet:
+        n_base = len(baseline) if baseline else 0
+        status = "ok" if not findings else f"{len(findings)} finding(s)"
+        print(f"jaxlint-threads: {status} ({n_base} baselined)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

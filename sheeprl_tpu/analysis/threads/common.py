@@ -1,0 +1,436 @@
+"""Shared concurrency model for the jaxlint-threads rules (JL008-JL012).
+
+Everything here is inference over a single module's AST — no imports are
+followed.  The rules share one picture of a module:
+
+* which attributes / globals hold synchronisation primitives
+  (``threading.Lock`` / ``RLock`` / ``Condition`` / ``Event``, ``queue.Queue``,
+  ``threading.Thread``), including ``Condition(self._lock)`` aliasing back to
+  its backing lock;
+* which methods run on their own thread (``threading.Thread(target=self._x)``
+  bodies, plus everything they call on ``self``, transitively);
+* a statement walker that tracks the set of held locks through ``with`` blocks
+  (``with a, b:`` acquires left-to-right) and bare ``.acquire()``/``.release()``
+  pairs.
+
+Locks are identified by *canonical names*: ``self.<attr>`` for instance
+attributes (a ``Condition`` wrapping a lock canonicalises to that lock),
+module-level names for globals, and ``<func>:<name>`` for function locals.
+Canonical names are line-free, so they are stable inside baseline fingerprints.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from sheeprl_tpu.analysis.rules.common import call_qualname, collect_aliases
+
+# Constructor qualnames -> primitive kind.
+_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "multiprocessing.Lock": "Lock",
+    "multiprocessing.RLock": "RLock",
+}
+_CONDITION_CTORS = {"threading.Condition", "multiprocessing.Condition"}
+_EVENT_CTORS = {"threading.Event", "multiprocessing.Event"}
+_QUEUE_CTORS = {
+    "queue.Queue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "queue.SimpleQueue",
+    "multiprocessing.Queue",
+    "collections.deque",
+}
+_THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+
+
+@dataclass(frozen=True)
+class LockRef:
+    """A resolved reference to a synchronisation primitive."""
+
+    name: str  # canonical, line-free (e.g. "self._lock", "_ACTIVE_LOCK", "f:a")
+    kind: str  # "Lock" | "RLock" | "Condition" | "Event" | "Queue" | "Thread"
+
+
+@dataclass
+class FuncInfo:
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    local_prims: Dict[str, LockRef] = field(default_factory=dict)
+
+
+@dataclass
+class ScopeModel:
+    """One lock namespace: a class (``self.*`` attrs + module globals visible)
+    or the module itself (globals + top-level functions)."""
+
+    name: str  # class name, or "<module>" for the pseudo-class of globals
+    node: ast.AST
+    module_aliases: Dict[str, str]
+    prims: Dict[str, LockRef] = field(default_factory=dict)  # attr/global -> ref
+    cond_backing: Dict[str, str] = field(default_factory=dict)  # cond attr -> lock attr
+    funcs: Dict[str, FuncInfo] = field(default_factory=dict)
+    thread_targets: Dict[str, "ThreadCreation"] = field(default_factory=dict)
+    thread_creations: List["ThreadCreation"] = field(default_factory=list)
+
+    def is_class(self) -> bool:
+        return isinstance(self.node, ast.ClassDef)
+
+
+@dataclass
+class ThreadCreation:
+    """One ``threading.Thread(...)`` construction site."""
+
+    call: ast.Call
+    func_name: str  # enclosing function
+    target: Optional[str]  # method/function name of target=..., when resolvable
+    daemon: Optional[bool]  # True/False when a literal, None when unknown/absent
+    binding: Optional[str]  # "self.X" / local var name the thread was bound to
+    in_loop: bool = False  # construction site inside a for/while: multi-instance
+
+
+def _func_defs(body: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield stmt
+
+
+def iter_own_calls(func: ast.AST) -> Iterator[ast.Call]:
+    """Calls lexically inside ``func`` but not inside nested defs/lambdas."""
+    stack: List[ast.AST] = list(func.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def stmt_own_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Calls in ``stmt``'s own expressions — not in nested statements (those are
+    visited separately by :func:`walk_held`) and not in lambdas."""
+    stack: List[ast.AST] = [
+        child
+        for child in ast.iter_child_nodes(stmt)
+        if not isinstance(child, (ast.stmt, ast.ExceptHandler))
+    ]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _ctor_kind(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    qn = call_qualname(call, aliases)
+    if qn is None:
+        return None
+    if qn in _LOCK_CTORS:
+        return _LOCK_CTORS[qn]
+    if qn in _CONDITION_CTORS:
+        return "Condition"
+    if qn in _EVENT_CTORS:
+        return "Event"
+    if qn in _QUEUE_CTORS:
+        return "Queue"
+    if qn in _THREAD_CTORS:
+        return "Thread"
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _literal_bool(node: Optional[ast.AST]) -> Optional[bool]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _thread_creation(
+    call: ast.Call, func_name: str, binding: Optional[str], in_loop: bool = False
+) -> ThreadCreation:
+    target: Optional[str] = None
+    daemon: Optional[bool] = None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            attr = _self_attr(kw.value)
+            if attr is not None:
+                target = attr
+            elif isinstance(kw.value, ast.Name):
+                target = kw.value.id
+        elif kw.arg == "daemon":
+            daemon = _literal_bool(kw.value)
+    return ThreadCreation(
+        call=call, func_name=func_name, target=target, daemon=daemon, binding=binding, in_loop=in_loop
+    )
+
+
+def _calls_under_loops(func: ast.AST) -> Set[ast.Call]:
+    """Call nodes lexically inside a for/while anywhere in ``func``."""
+    out: Set[ast.Call] = set()
+    for node in ast.walk(func):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    out.add(sub)
+    return out
+
+
+def _scan_assignments(scope: ScopeModel, func: ast.AST, aliases: Dict[str, str], *, attr_owner: bool) -> None:
+    """Record primitive bindings (``self.x = Lock()`` / ``x = Lock()``) and
+    thread creations found in ``func``."""
+    info = scope.funcs.setdefault(
+        getattr(func, "name", "<lambda>"), FuncInfo(name=getattr(func, "name", "<lambda>"), node=func)
+    )
+    looped_calls = _calls_under_loops(func)
+    for stmt in ast.walk(func):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and stmt is not func:
+            continue
+        value: Optional[ast.AST] = None
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            value, targets = stmt.value, stmt.targets
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            value, targets = stmt.value, [stmt.target]
+        if not isinstance(value, ast.Call):
+            continue
+        kind = _ctor_kind(value, aliases)
+        if kind is None:
+            continue
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr is not None and attr_owner:
+                name = f"self.{attr}"
+                scope.prims[attr] = LockRef(name=name, kind=kind)
+                if kind == "Condition" and value.args:
+                    backing = _self_attr(value.args[0])
+                    if backing is not None:
+                        scope.cond_backing[attr] = backing
+            elif isinstance(tgt, ast.Name):
+                info.local_prims[tgt.id] = LockRef(name=f"{info.name}:{tgt.id}", kind=kind)
+        if kind == "Thread":
+            binding = None
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                binding = f"self.{attr}" if attr is not None else (tgt.id if isinstance(tgt, ast.Name) else None)
+            creation = _thread_creation(value, info.name, binding, in_loop=value in looped_calls)
+            scope.thread_creations.append(creation)
+            if creation.target:
+                scope.thread_targets.setdefault(creation.target, creation)
+    # Thread(...) used without being bound (e.g. Thread(...).start())
+    for call in iter_own_calls(func):
+        if _ctor_kind(call, aliases) == "Thread":
+            already = any(c.call is call for c in scope.thread_creations)
+            if not already:
+                creation = _thread_creation(call, info.name, None, in_loop=call in looped_calls)
+                scope.thread_creations.append(creation)
+                if creation.target:
+                    scope.thread_targets.setdefault(creation.target, creation)
+
+
+def build_scope_models(tree: ast.AST) -> Tuple[List[ScopeModel], Dict[str, str]]:
+    """Return (models, aliases): one ScopeModel per class plus one for the
+    module's top-level functions/globals."""
+    aliases = collect_aliases(tree)
+    models: List[ScopeModel] = []
+
+    module_scope = ScopeModel(name="<module>", node=tree, module_aliases=aliases)
+    # Module-level primitive globals: X = threading.Lock() at top level.
+    for stmt in tree.body:
+        value: Optional[ast.AST] = None
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            value, targets = stmt.value, stmt.targets
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            value, targets = stmt.value, [stmt.target]
+        if isinstance(value, ast.Call):
+            kind = _ctor_kind(value, aliases)
+            if kind is not None:
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        module_scope.prims[tgt.id] = LockRef(name=tgt.id, kind=kind)
+    for func in _func_defs(tree.body):
+        _scan_assignments(module_scope, func, aliases, attr_owner=False)
+    models.append(module_scope)
+
+    for stmt in ast.walk(tree):
+        if not isinstance(stmt, ast.ClassDef):
+            continue
+        scope = ScopeModel(name=stmt.name, node=stmt, module_aliases=aliases)
+        # Inherit visibility of module globals so `with _ACTIVE_LOCK:` resolves
+        # inside methods (shared dict reference is intentional).
+        scope.prims.update(module_scope.prims)
+        for func in _func_defs(stmt.body):
+            _scan_assignments(scope, func, aliases, attr_owner=True)
+        models.append(scope)
+    return models, aliases
+
+
+# ----------------------------------------------------------------- resolution
+def canonical_lock(scope: ScopeModel, func: Optional[FuncInfo], expr: ast.AST) -> Optional[LockRef]:
+    """Resolve an expression naming a lock-like primitive to its canonical ref.
+
+    A ``Condition`` wrapping ``self._lock`` canonicalises to ``self._lock`` so
+    guard/ordering analysis treats them as one mutex (kind stays "Condition"
+    when unbacked, since it owns a private RLock)."""
+    attr = _self_attr(expr)
+    if attr is not None:
+        ref = scope.prims.get(attr)
+        if ref is None:
+            return None
+        if ref.kind == "Condition":
+            backing = scope.cond_backing.get(attr)
+            if backing is not None and backing in scope.prims:
+                base = scope.prims[backing]
+                return LockRef(name=f"self.{backing}", kind=base.kind)
+        return ref
+    if isinstance(expr, ast.Name):
+        if func is not None and expr.id in func.local_prims:
+            return func.local_prims[expr.id]
+        return scope.prims.get(expr.id)
+    return None
+
+
+_MUTEX_KINDS = ("Lock", "RLock", "Condition")
+
+
+def is_mutex(ref: Optional[LockRef]) -> bool:
+    return ref is not None and ref.kind in _MUTEX_KINDS
+
+
+# ------------------------------------------------------------- held-lock walk
+def walk_held(
+    scope: ScopeModel,
+    func: ast.AST,
+    visit: Callable[[ast.stmt, Tuple[LockRef, ...]], None],
+    on_acquire: Optional[Callable[[LockRef, Tuple[LockRef, ...], ast.AST], None]] = None,
+) -> None:
+    """Walk ``func``'s statements in order, calling ``visit(stmt, held)`` with
+    the tuple of locks held at that statement (outermost first) and
+    ``on_acquire(lock, held_before, site)`` at each acquisition.
+
+    Tracks ``with <lock>:`` (including multi-item ``with a, b:``) and
+    best-effort ``<lock>.acquire()`` ... ``<lock>.release()`` straight-line
+    pairs within one statement list.  Does not descend into nested defs."""
+    info = scope.funcs.get(getattr(func, "name", ""), None)
+
+    def resolve(expr: ast.AST) -> Optional[LockRef]:
+        ref = canonical_lock(scope, info, expr)
+        return ref if is_mutex(ref) else None
+
+    def handle_block(stmts: Sequence[ast.stmt], held: Tuple[LockRef, ...]) -> None:
+        acquired_here: List[LockRef] = []
+        current = held
+        for stmt in stmts:
+            visit(stmt, current)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = current
+                for item in stmt.items:
+                    # `with lock:` / `with cond:` / `with a, b:` (left to right)
+                    ref = resolve(item.context_expr)
+                    if ref is not None:
+                        if on_acquire is not None:
+                            on_acquire(ref, inner, item.context_expr)
+                        if all(h.name != ref.name for h in inner):
+                            inner = inner + (ref,)
+                handle_block(stmt.body, inner)
+                continue
+            if isinstance(stmt, (ast.If,)):
+                handle_block(stmt.body, current)
+                handle_block(stmt.orelse, current)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                handle_block(stmt.body, current)
+                handle_block(stmt.orelse, current)
+                continue
+            if isinstance(stmt, ast.Try):
+                handle_block(stmt.body, current)
+                for handler in stmt.handlers:
+                    handle_block(handler.body, current)
+                handle_block(stmt.orelse, current)
+                handle_block(stmt.finalbody, current)
+                continue
+            # Bare acquire()/release() calls as expression statements.
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute):
+                    if call.func.attr == "acquire":
+                        ref = resolve(call.func.value)
+                        if ref is not None:
+                            if on_acquire is not None:
+                                on_acquire(ref, current, call)
+                            if all(h.name != ref.name for h in current):
+                                current = current + (ref,)
+                                acquired_here.append(ref)
+                    elif call.func.attr == "release":
+                        ref = resolve(call.func.value)
+                        if ref is not None:
+                            current = tuple(h for h in current if h.name != ref.name)
+                            acquired_here = [a for a in acquired_here if a.name != ref.name]
+
+    handle_block(func.body, ())
+
+
+def reads_of_self(func: ast.AST) -> Set[str]:
+    """Attributes of ``self`` read (Load context) anywhere in ``func``."""
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):  # type: ignore[attr-defined]
+            out.add(attr)
+    return out
+
+
+def self_calls(func: ast.AST) -> Set[str]:
+    """Names of methods invoked as ``self.m(...)`` inside ``func`` (own calls only)."""
+    out: Set[str] = set()
+    for call in iter_own_calls(func):
+        attr = _self_attr(call.func)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+def _closure_over_self_calls(scope: ScopeModel, seeds: Set[str]) -> Set[str]:
+    reachable = set(s for s in seeds if s in scope.funcs)
+    frontier = list(reachable)
+    while frontier:
+        name = frontier.pop()
+        info = scope.funcs.get(name)
+        if info is None:
+            continue
+        for callee in self_calls(info.node):
+            if callee in scope.funcs and callee not in reachable:
+                reachable.add(callee)
+                frontier.append(callee)
+    return reachable
+
+
+def thread_reachable(scope: ScopeModel) -> Set[str]:
+    """Method names that may execute on a spawned thread: declared
+    ``Thread(target=...)`` bodies plus their transitive ``self.*()`` callees."""
+    return _closure_over_self_calls(scope, set(scope.thread_targets))
+
+
+def multi_instance_reachable(scope: ScopeModel) -> Set[str]:
+    """Method names that may execute on SEVERAL threads at once: targets whose
+    ``Thread(...)`` construction sits inside a loop (one thread per connection /
+    per worker), plus their transitive ``self.*()`` callees."""
+    seeds = {c.target for c in scope.thread_creations if c.in_loop and c.target}
+    return _closure_over_self_calls(scope, seeds)

@@ -1,0 +1,129 @@
+"""JL008: unguarded shared mutation.
+
+An instance attribute written both from a thread body (``Thread(target=...)``
+method or anything it calls on ``self``) and from another method, with no lock
+guarding *every* one of those writes, is a data race waiting for a scheduler
+interleaving.  ``__init__`` writes are exempt — construction happens-before
+``Thread.start()`` (start-order violations are JL011's job).
+
+The guard test is canonical-lock intersection: each write site records the set
+of locks held at the statement (``with self._lock:`` regions, ``Condition``
+canonicalised to its backing lock, best-effort ``.acquire()`` pairs); the rule
+fires when the intersection over all non-``__init__`` write sites is empty.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from sheeprl_tpu.analysis.core import Finding
+from sheeprl_tpu.analysis.engine import Module, Rule
+from sheeprl_tpu.analysis.threads.common import (
+    ScopeModel,
+    build_scope_models,
+    multi_instance_reachable,
+    thread_reachable,
+    walk_held,
+)
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__enter__"}
+
+
+def _attr_writes(stmt: ast.stmt) -> List[Tuple[str, bool]]:
+    """``(self.X, is_read_modify_write)`` targets written by this statement."""
+    targets: List[ast.AST] = []
+    rmw = isinstance(stmt, ast.AugAssign)
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out: List[Tuple[str, bool]] = []
+    for tgt in targets:
+        nodes = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+        for node in nodes:
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                out.append((node.attr, rmw))
+    return out
+
+
+class UnguardedSharedMutation(Rule):
+    id = "JL008"
+    name = "unguarded-shared-mutation"
+    scope = "file"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        models, _ = build_scope_models(module.tree)
+        for scope in models:
+            if scope.is_class() and scope.thread_targets:
+                findings.extend(self._check_class(module, scope))
+        return findings
+
+    def _check_class(self, module: Module, scope: ScopeModel) -> List[Finding]:
+        reachable = thread_reachable(scope)
+        if not reachable:
+            return []
+        multi = multi_instance_reachable(scope)
+        # attr -> list of (method, guard-set, line, is_read_modify_write)
+        writes: Dict[str, List[Tuple[str, Set[str], int, bool]]] = {}
+        for name, info in scope.funcs.items():
+            if name in _EXEMPT_METHODS:
+                continue
+
+            def visit(stmt: ast.stmt, held, _name=name) -> None:
+                guards = {h.name for h in held}
+                for attr, rmw in _attr_writes(stmt):
+                    if attr in scope.prims:
+                        continue  # rebinding a primitive is lifecycle, not data
+                    writes.setdefault(attr, []).append((_name, guards, stmt.lineno, rmw))
+
+            walk_held(scope, info.node, visit)
+
+        findings: List[Finding] = []
+        for attr, sites in sorted(writes.items()):
+            methods = {m for m, _, _, _ in sites}
+            line = min(ln for _, _, ln, _ in sites)
+            common = set.intersection(*(g for _, g, _, _ in sites))
+            if (methods & reachable) and len(methods) >= 2 and not common:
+                thread_side = sorted(methods & reachable)
+                other_side = sorted(methods - reachable) or thread_side
+                findings.append(
+                    Finding(
+                        rule=self.id,
+                        path=module.path,
+                        line=line,
+                        col=0,
+                        message=(
+                            f"self.{attr} written from thread body {thread_side[0]}() and from "
+                            f"{other_side[0]}() with no common lock held"
+                        ),
+                        detail=f"{scope.name}.{attr}:writers={','.join(sorted(methods))}",
+                    )
+                )
+                continue
+            # Same-method races: a read-modify-write (+=) in a method that runs
+            # on one thread PER connection/worker races against its own copies.
+            rmw_unguarded = [
+                (m, ln) for m, g, ln, rmw in sites if rmw and not g and m in multi
+            ]
+            if rmw_unguarded:
+                m, ln = min(rmw_unguarded, key=lambda t: t[1])
+                findings.append(
+                    Finding(
+                        rule=self.id,
+                        path=module.path,
+                        line=ln,
+                        col=0,
+                        message=(
+                            f"self.{attr} += ... in {m}(), which runs on one thread per "
+                            "connection/worker — unguarded read-modify-write loses updates"
+                        ),
+                        detail=f"{scope.name}.{attr}:rmw:{m}",
+                    )
+                )
+        return findings

@@ -1,0 +1,198 @@
+"""JL009: lock-order inversion.
+
+Builds a static lock-acquisition graph per lock namespace (class, or the
+module's top-level functions): acquiring B while holding A adds edge A->B,
+both from lexically nested ``with`` blocks (``with a: with b:`` and
+``with a, b:``) and from cross-method call edges (``with a: self.m()`` where
+``m`` transitively acquires ``b``).  Any cycle in that graph is a potential
+deadlock between two threads taking the locks in opposite orders.
+
+Re-acquiring the *same* ``RLock`` (or a ``Condition`` canonicalised to one)
+is reentrant, not a cycle; a self-edge on a plain ``Lock`` is reported — that
+is a single-thread self-deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.core import Finding
+from sheeprl_tpu.analysis.engine import Module, Rule
+from sheeprl_tpu.analysis.threads.common import (
+    LockRef,
+    ScopeModel,
+    build_scope_models,
+    stmt_own_calls,
+    walk_held,
+)
+
+
+class LockOrderInversion(Rule):
+    id = "JL009"
+    name = "lock-order-inversion"
+    scope = "file"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        models, _ = build_scope_models(module.tree)
+        for scope in models:
+            findings.extend(self._check_scope(module, scope))
+        return findings
+
+    def _check_scope(self, module: Module, scope: ScopeModel) -> List[Finding]:
+        if not scope.funcs:
+            return []
+        kinds: Dict[str, str] = {}
+        # direct acquisition edges + per-method summaries
+        edges: Dict[Tuple[str, str], int] = {}  # (a, b) -> earliest line
+        acquires: Dict[str, Set[str]] = {}  # method -> locks acquired anywhere in it
+        calls_held: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}  # method -> (callee, held)
+
+        for name, info in scope.funcs.items():
+            acquired: Set[str] = set()
+            calls: List[Tuple[str, Tuple[str, ...]]] = []
+
+            def on_acquire(ref: LockRef, held, site) -> None:
+                kinds[ref.name] = ref.kind
+                acquired.add(ref.name)
+                line = getattr(site, "lineno", 1)
+                for h in held:
+                    if h.name == ref.name and ref.kind == "RLock":
+                        continue  # reentrant re-acquire, not an ordering edge
+                    key = (h.name, ref.name)
+                    edges[key] = min(edges.get(key, line), line)
+
+            def visit(stmt, held) -> None:
+                pass
+
+            walk_held(scope, info.node, visit, on_acquire=on_acquire)
+            # cross-method call sites with their held sets
+            def visit_calls(stmt, held) -> None:
+                if not held:
+                    return
+                for call in stmt_own_calls(stmt):
+                    callee = _self_callee(call)
+                    if callee is not None and callee in scope.funcs:
+                        calls.append((callee, tuple(h.name for h in held)))
+
+            walk_held(scope, info.node, visit_calls)
+            acquires[name] = acquired
+            calls_held[name] = calls
+
+        # transitive closure of per-method acquisitions through self-calls
+        trans: Dict[str, Set[str]] = {m: set(a) for m, a in acquires.items()}
+        changed = True
+        while changed:
+            changed = False
+            for m, calls in calls_held.items():
+                for callee, _ in calls:
+                    extra = trans.get(callee, set()) - trans[m]
+                    if extra:
+                        trans[m] |= extra
+                        changed = True
+        for m, calls in calls_held.items():
+            for callee, held in calls:
+                for b in trans.get(callee, ()):
+                    for a in held:
+                        if a == b and kinds.get(b) == "RLock":
+                            continue
+                        key = (a, b)
+                        if key not in edges:
+                            edges[key] = 1
+
+        # cycle detection over the edge graph
+        graph: Dict[str, Set[str]] = {}
+        for (a, b), _ in edges.items():
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        findings: List[Finding] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for cycle in _cycles(graph):
+            if len(cycle) == 1 and kinds.get(cycle[0]) == "RLock":
+                continue  # reentrancy is legal
+            key = tuple(sorted(cycle))
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            line = min(
+                (edges[(a, b)] for a in cycle for b in cycle if (a, b) in edges),
+                default=1,
+            )
+            desc = "<->".join(key) if len(key) > 1 else f"{key[0]} (self-deadlock)"
+            findings.append(
+                Finding(
+                    rule=self.id,
+                    path=module.path,
+                    line=line,
+                    col=0,
+                    message=f"lock-order cycle in {scope.name}: {desc}",
+                    detail=f"{scope.name}:{'|'.join(key)}",
+                )
+            )
+        return findings
+
+
+def _self_callee(call: ast.Call) -> Optional[str]:
+    func = call.func
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "self"
+    ):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components with >1 node, plus self-loops."""
+    index: Dict[str, int] = {}
+    lowlink: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    out: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = lowlink[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = lowlink[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    lowlink[node] = min(lowlink[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == index[node]:
+                comp: List[str] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1 or node in graph.get(node, ()):
+                    out.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out

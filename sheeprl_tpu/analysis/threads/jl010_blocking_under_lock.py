@@ -1,0 +1,152 @@
+"""JL010: blocking call under a held lock.
+
+A lock held across a call that can park the thread — socket/channel I/O,
+``jax.device_get`` / ``.block_until_ready()`` host syncs, blocking
+``queue.get/put``, subprocess waits, ``time.sleep``, ``Event.wait`` — turns
+every other thread contending for that lock into a convoy (and, for locks the
+hot path takes, stalls the learner).  The fix is almost always to snapshot
+state under the lock and do the slow call outside it.
+
+Receiver-sensitive matching keeps this precise:
+
+* ``.get``/``.put`` only fire on receivers inferred to be queues (``self.q =
+  queue.Queue()`` / local equivalents), never on dicts, and never for the
+  ``_nowait`` variants or ``block=False``;
+* ``.wait`` only fires on inferred ``Event``/``Condition`` receivers — and a
+  ``Condition.wait`` is exempt when the only lock held is the condition's own
+  backing lock (that is how conditions work; JL012 polices the predicate loop);
+* bare attribute names (``send``/``recv``/``accept``/``connect``/``sendall``)
+  match any receiver — in this codebase those are sockets and framed channels.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from sheeprl_tpu.analysis.core import Finding
+from sheeprl_tpu.analysis.engine import Module, Rule
+from sheeprl_tpu.analysis.rules.common import call_qualname
+from sheeprl_tpu.analysis.threads.common import (
+    ScopeModel,
+    build_scope_models,
+    canonical_lock,
+    stmt_own_calls,
+    walk_held,
+)
+
+_SOCKET_ATTRS = {"send", "sendall", "recv", "recvfrom", "recv_into", "accept", "connect"}
+_BLOCKING_QUALNAMES = {
+    "time.sleep",
+    "jax.device_get",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+}
+_BLOCKING_ATTRS = {"block_until_ready", "communicate"}
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_nonblocking_queue_call(call: ast.Call) -> bool:
+    blk = _kw(call, "block")
+    if isinstance(blk, ast.Constant) and blk.value is False:
+        return True
+    if call.args:
+        first = call.args[0]
+        # q.get(False) / q.put(item, False)
+        idx = 0 if isinstance(call.func, ast.Attribute) and call.func.attr == "get" else 1
+        if idx < len(call.args):
+            arg = call.args[idx]
+            if isinstance(arg, ast.Constant) and arg.value is False:
+                return True
+    return False
+
+
+class BlockingCallUnderLock(Rule):
+    id = "JL010"
+    name = "blocking-call-under-lock"
+    scope = "file"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        models, aliases = build_scope_models(module.tree)
+        for scope in models:
+            findings.extend(self._check_scope(module, scope, aliases))
+        return findings
+
+    def _check_scope(self, module: Module, scope: ScopeModel, aliases) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        for name, info in scope.funcs.items():
+
+            def visit(stmt: ast.stmt, held, _name=name, _info=info) -> None:
+                if not held:
+                    return
+                for call in stmt_own_calls(stmt):
+                    desc = self._blocking_desc(scope, _info, call, held, aliases)
+                    if desc is None:
+                        continue
+                    lock_names = ",".join(h.name for h in held)
+                    detail = f"{scope.name}.{_name}:{desc}:under:{lock_names}"
+                    if detail in seen:
+                        continue
+                    seen.add(detail)
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=module.path,
+                            line=getattr(call, "lineno", stmt.lineno),
+                            col=getattr(call, "col_offset", 0),
+                            message=f"blocking call {desc} while holding {lock_names}",
+                            detail=detail,
+                        )
+                    )
+
+            walk_held(scope, info.node, visit)
+        return findings
+
+    def _blocking_desc(self, scope, info, call: ast.Call, held, aliases) -> Optional[str]:
+        qn = call_qualname(call, aliases)
+        if qn in _BLOCKING_QUALNAMES:
+            return qn
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv_ref = canonical_lock(scope, info, func.value)
+        if attr in ("get", "put"):
+            if recv_ref is None or recv_ref.kind != "Queue":
+                return None
+            if _is_nonblocking_queue_call(call):
+                return None
+            return f"{recv_ref.name}.{attr}"
+        if attr == "wait":
+            if recv_ref is None:
+                return None
+            if recv_ref.kind == "Event":
+                return f"{recv_ref.name}.wait"
+            # Condition canonicalises to its backing mutex; holding only that
+            # mutex is the documented wait protocol.
+            if recv_ref.kind in ("Lock", "RLock", "Condition"):
+                others = [h.name for h in held if h.name != recv_ref.name]
+                if others:
+                    return f"{recv_ref.name}.wait"
+            return None
+        if attr == "join":
+            if recv_ref is not None and recv_ref.kind == "Thread":
+                return f"{recv_ref.name}.join"
+            return None
+        if attr in _SOCKET_ATTRS:
+            target = ast.unparse(func.value) if hasattr(ast, "unparse") else "?"
+            return f"{target}.{attr}"
+        if attr in _BLOCKING_ATTRS:
+            return f".{attr}"
+        return None

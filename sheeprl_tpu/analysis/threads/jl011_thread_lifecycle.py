@@ -1,0 +1,221 @@
+"""JL011: thread lifecycle hazards.
+
+Three checks over every ``threading.Thread(...)`` construction site and its
+target body:
+
+* **never-joined** — a non-daemon thread (no ``daemon=True``) whose binding is
+  never ``.join()``-ed anywhere in the module: interpreter shutdown blocks on
+  it, and nothing observes its death.  Daemon threads are exempt by design.
+* **start-before-init** — ``__init__`` starts a thread at statement *i* whose
+  target body reads ``self`` attributes only assigned after statement *i*: the
+  thread can observe a half-constructed object.
+* **unstoppable-daemon-loop** — a thread target whose body is ``while True:``
+  with no ``break``/``return``/``raise`` inside and no stop-``Event`` consulted
+  in the loop test: the thread can only die with the process, so shutdown
+  paths (and tests) cannot reclaim it deterministically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from sheeprl_tpu.analysis.core import Finding
+from sheeprl_tpu.analysis.engine import Module, Rule
+from sheeprl_tpu.analysis.threads.common import (
+    ScopeModel,
+    ThreadCreation,
+    build_scope_models,
+    reads_of_self,
+)
+
+
+def _has_join(tree: ast.AST, binding: Optional[str]) -> bool:
+    """True when ``<binding>.join(...)`` (or any ``.join`` on an unknown
+    binding) appears anywhere in the module — deliberately loose: joins through
+    a collection (``for t in threads: t.join()``) count."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr != "join":
+            continue
+        if binding is None:
+            return True
+        recv = node.func.value
+        if binding.startswith("self."):
+            attr = binding[len("self.") :]
+            if (
+                isinstance(recv, ast.Attribute)
+                and recv.attr == attr
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+            ):
+                return True
+        elif isinstance(recv, ast.Name):
+            # local bindings are commonly renamed/aggregated; any Name.join matches
+            return True
+    return False
+
+
+def _loop_exits(loop: ast.While) -> bool:
+    """Does this loop body contain any way out (break/return/raise), ignoring
+    nested loops' own breaks?  Nested defs don't count."""
+    stack: List[ast.AST] = list(loop.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, (ast.Return, ast.Raise)):
+            return True
+        if isinstance(node, ast.Break):
+            return True
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            # a break inside a nested loop exits only that loop; but a
+            # return/raise still exits — recurse without Break counting
+            if _inner_returns(node):
+                return True
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _inner_returns(loop: ast.AST) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, (ast.Return, ast.Raise)):
+            return True
+    return False
+
+
+def _is_while_true(loop: ast.While) -> bool:
+    return isinstance(loop.test, ast.Constant) and bool(loop.test.value) is True
+
+
+class ThreadLifecycle(Rule):
+    id = "JL011"
+    name = "thread-lifecycle"
+    scope = "file"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        models, _ = build_scope_models(module.tree)
+        for scope in models:
+            for creation in scope.thread_creations:
+                findings.extend(self._check_creation(module, scope, creation))
+            for target, creation in sorted(scope.thread_targets.items()):
+                findings.extend(self._check_loop(module, scope, target))
+            findings.extend(self._check_init_order(module, scope))
+        return findings
+
+    # ------------------------------------------------------------ never-joined
+    def _check_creation(self, module: Module, scope: ScopeModel, creation: ThreadCreation) -> List[Finding]:
+        if creation.daemon is True:
+            return []
+        if _has_join(module.tree, creation.binding):
+            return []
+        who = creation.binding or creation.target or "<unbound>"
+        return [
+            Finding(
+                rule=self.id,
+                path=module.path,
+                line=creation.call.lineno,
+                col=creation.call.col_offset,
+                message=f"non-daemon thread {who} is never joined (and not daemon=True)",
+                detail=f"{scope.name}:never-joined:{who}",
+            )
+        ]
+
+    # --------------------------------------------------------- daemon-loop-stop
+    def _check_loop(self, module: Module, scope: ScopeModel, target: str) -> List[Finding]:
+        info = scope.funcs.get(target)
+        if info is None:
+            return []
+        findings: List[Finding] = []
+        for stmt in ast.walk(info.node):
+            if not isinstance(stmt, ast.While) or not _is_while_true(stmt):
+                continue
+            if _loop_exits(stmt):
+                continue
+            findings.append(
+                Finding(
+                    rule=self.id,
+                    path=module.path,
+                    line=stmt.lineno,
+                    col=stmt.col_offset,
+                    message=(
+                        f"thread body {target}() loops forever with no stop Event, "
+                        "break, or return — unreclaimable except by process exit"
+                    ),
+                    detail=f"{scope.name}:unstoppable-loop:{target}",
+                )
+            )
+        return findings
+
+    # -------------------------------------------------------- start-before-init
+    def _check_init_order(self, module: Module, scope: ScopeModel) -> List[Finding]:
+        if not scope.is_class():
+            return []
+        init = scope.funcs.get("__init__")
+        if init is None:
+            return []
+        findings: List[Finding] = []
+        # map statement order in __init__: starts and attr assignments
+        stmts = list(ast.walk(init.node))
+        start_lines = []  # (line, target)
+        for node in stmts:
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "start"
+            ):
+                recv = node.func.value
+                target: Optional[str] = None
+                if (
+                    isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"
+                ):
+                    binding = f"self.{recv.attr}"
+                    for c in scope.thread_creations:
+                        if c.binding == binding:
+                            target = c.target
+                elif isinstance(recv, ast.Name):
+                    for c in scope.thread_creations:
+                        if c.binding == recv.id and c.func_name == "__init__":
+                            target = c.target
+                if target:
+                    start_lines.append((node.lineno, target))
+        if not start_lines:
+            return []
+        assigns = {}  # attr -> first assignment line in __init__
+        for node in stmts:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        assigns.setdefault(tgt.attr, node.lineno)
+        for line, target in start_lines:
+            info = scope.funcs.get(target)
+            if info is None:
+                continue
+            needed = reads_of_self(info.node)
+            late = sorted(a for a in needed if assigns.get(a, 0) > line)
+            if late:
+                findings.append(
+                    Finding(
+                        rule=self.id,
+                        path=module.path,
+                        line=line,
+                        col=0,
+                        message=(
+                            f"__init__ starts thread target {target}() before assigning "
+                            f"attribute(s) it reads: {', '.join('self.' + a for a in late)}"
+                        ),
+                        detail=f"{scope.name}:start-before-init:{target}:{','.join(late)}",
+                    )
+                )
+        return findings

@@ -1,0 +1,75 @@
+"""JL012: ``Condition.wait()`` without a predicate re-check loop.
+
+``Condition.wait`` can return spuriously, and between ``notify`` and wake-up
+another thread may have consumed the state change — the documented protocol is
+
+    with cond:
+        while not predicate():
+            cond.wait(timeout)
+
+A ``cond.wait()`` that is not (lexically) inside a ``while`` loop acts on a
+one-shot signal it has no right to trust.  ``Event.wait`` is exempt (events
+latch); ``cond.wait_for(pred)`` is exempt (the loop is built in).  Any
+enclosing ``while`` counts — ``while True: cond.wait(); if pred: break`` is a
+predicate loop too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from sheeprl_tpu.analysis.core import Finding
+from sheeprl_tpu.analysis.engine import Module, Rule
+from sheeprl_tpu.analysis.threads.common import build_scope_models, canonical_lock
+
+
+class ConditionWaitWithoutLoop(Rule):
+    id = "JL012"
+    name = "condition-wait-no-predicate-loop"
+    scope = "file"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        models, _ = build_scope_models(module.tree)
+        for scope in models:
+            for name, info in scope.funcs.items():
+                findings.extend(self._check_func(module, scope, name, info))
+        return findings
+
+    def _check_func(self, module, scope, name, info) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def walk(node: ast.AST, in_while: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                child_in_while = in_while or isinstance(child, ast.While)
+                if (
+                    isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr == "wait"
+                    and not in_while
+                ):
+                    ref = canonical_lock(scope, info, child.func.value)
+                    if ref is not None and ref.kind in ("Condition", "Lock", "RLock"):
+                        # Lock/RLock kinds appear when the Condition canonicalised
+                        # to its backing mutex; the receiver is still a Condition.
+                        recv = ast.unparse(child.func.value) if hasattr(ast, "unparse") else ref.name
+                        findings.append(
+                            Finding(
+                                rule=self.id,
+                                path=module.path,
+                                line=child.lineno,
+                                col=child.col_offset,
+                                message=(
+                                    f"{recv}.wait() outside a while predicate loop — "
+                                    "spurious wake-ups and missed notifies go unchecked"
+                                ),
+                                detail=f"{scope.name}.{name}:{recv}.wait",
+                            )
+                        )
+                walk(child, child_in_while)
+
+        walk(info.node, False)
+        return findings

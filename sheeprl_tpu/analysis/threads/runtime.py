@@ -1,0 +1,411 @@
+"""Runtime lock-order race detector — the dynamic half of jaxlint-threads.
+
+Opt-in (``analysis.race_detect=True`` config or ``SHEEPRL_TPU_RACE_DETECT=1``),
+installed at the same boundary as the flight recorder: :func:`install` swaps
+``threading.Lock`` / ``RLock`` / ``Condition`` for instrumented wrappers (and
+shims ``time.sleep``), so every lock created afterwards reports to the active
+:class:`RaceDetector`, which maintains:
+
+* a per-thread held-lock stack (RLock re-entry counts, never double-pushes);
+* the dynamic lock-order graph — acquiring B while holding A adds edge A→B;
+  any cycle across the whole run is a potential deadlock (two threads took the
+  same locks in opposite orders), reported even when the timing never actually
+  deadlocked;
+* held-longer-than-threshold sections (``race_hold_ms``) and blocking calls
+  observed while holding a lock (``time.sleep``, ``Condition.wait`` with extra
+  locks held) — the runtime mirror of JL010.
+
+The report is JSONL under ``<log_dir>/races/`` (one object per line: summary,
+then edges / cycles / long-holds / blocking events); headline counts also merge
+into the flight recorder (``race_report`` event) and the fleet exporter
+(``race_*`` gauges) when those planes are up.
+
+Locks are named by construction site (``Lock#3@obs/fleet.py:481``) so reports
+are stable across runs of the same build.  Everything here is stdlib-only and
+single-purpose: the detector observes, it never changes blocking semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from sheeprl_tpu.analysis.threads.jl009_lock_order import _cycles
+
+ENV_VAR = "SHEEPRL_TPU_RACE_DETECT"
+
+# Real factories, captured at import (before any install can patch them).
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+_REAL_SLEEP = time.sleep
+
+#: Cap per-category event lists so a pathological run cannot OOM the detector.
+_MAX_EVENTS = 256
+
+
+def _caller_site(skip: int = 2) -> str:
+    """``path:lineno`` of the first frame outside this module and threading/queue."""
+    try:
+        for frame in reversed(traceback.extract_stack(limit=12)[:-skip]):
+            fn = frame.filename.replace("\\", "/")
+            if fn.endswith(("analysis/threads/runtime.py",)) or "/threading.py" in fn or "/queue.py" in fn:
+                continue
+            parts = fn.split("/")
+            return f"{'/'.join(parts[-2:])}:{frame.lineno}"
+    except Exception:  # pragma: no cover - never let naming break a lock
+        pass
+    return "?:0"
+
+
+class _InstrumentedLock:
+    """Duck-typed Lock/RLock proxy; also implements the private protocol
+    ``threading.Condition`` probes for (``_is_owned`` / ``_release_save`` /
+    ``_acquire_restore``), keeping the detector's held-set exact across
+    ``Condition.wait``."""
+
+    __slots__ = ("_inner", "_det", "name", "kind")
+
+    def __init__(self, inner: Any, det: "RaceDetector", name: str, kind: str):
+        self._inner = inner
+        self._det = det
+        self.name = name
+        self.kind = kind
+
+    # ------------------------------------------------------------- lock API
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._det._on_acquired(self, blocking=blocking)
+        return got
+
+    def release(self) -> None:
+        self._det._on_release(self)
+        self._inner.release()
+
+    def __enter__(self) -> "_InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        return bool(locked()) if locked is not None else self._is_owned()
+
+    # -------------------------------------------- Condition interop protocol
+    def _is_owned(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):  # plain Lock probe, bypasses the detector
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self) -> Any:
+        self._det._on_release(self, full=True, waiting=True)
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            return inner._release_save()
+        inner.release()
+        return None
+
+    def _acquire_restore(self, state: Any) -> None:
+        inner = self._inner
+        if state is not None and hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        self._det._on_acquired(self, blocking=True)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<instrumented {self.kind} {self.name}>"
+
+
+class RaceDetector:
+    """Collects the dynamic lock-order graph and JL010-style runtime events."""
+
+    def __init__(self, log_dir: Optional[str] = None, held_threshold_ms: float = 200.0):
+        self.log_dir = log_dir
+        self.held_threshold_s = max(float(held_threshold_ms), 0.0) / 1000.0
+        self._tls = threading.local()
+        self._meta = _REAL_LOCK()  # raw: guards everything below
+        self._seq = 0
+        self._edges: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._long_holds: List[Dict[str, Any]] = []
+        self._blocking: List[Dict[str, Any]] = []
+        self._locks_created = 0
+        self._acquisitions = 0
+
+    # ------------------------------------------------------------- factories
+    def make_lock(self) -> _InstrumentedLock:
+        return self._wrap(_REAL_LOCK(), "Lock")
+
+    def make_rlock(self) -> _InstrumentedLock:
+        return self._wrap(_REAL_RLOCK(), "RLock")
+
+    def make_condition(self, lock: Any = None) -> Any:
+        if lock is None:
+            lock = self.make_rlock()
+        return _REAL_CONDITION(lock)
+
+    def _wrap(self, inner: Any, kind: str) -> _InstrumentedLock:
+        with self._meta:
+            self._seq += 1
+            self._locks_created += 1
+            seq = self._seq
+        name = f"{kind}#{seq}@{_caller_site()}"
+        return _InstrumentedLock(inner, self, name, kind)
+
+    # ---------------------------------------------------------- held tracking
+    def _stack(self) -> List[Dict[str, Any]]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def held_names(self) -> List[str]:
+        return [e["lock"].name for e in self._stack()]
+
+    def _on_acquired(self, lock: _InstrumentedLock, blocking: bool = True) -> None:
+        stack = self._stack()
+        for entry in stack:
+            if entry["lock"] is lock:  # RLock re-entry: count, no new edge
+                entry["count"] += 1
+                return
+        if blocking and stack:
+            with self._meta:
+                self._acquisitions += 1
+                for held in stack:
+                    key = (held["lock"].name, lock.name)
+                    rec = self._edges.get(key)
+                    if rec is None:
+                        # stack walk only on a never-seen edge: the steady state
+                        # is one dict hit + int bump per nested acquisition
+                        self._edges[key] = {
+                            "count": 1,
+                            "thread": threading.current_thread().name,
+                            "site": _caller_site(),
+                        }
+                    else:
+                        rec["count"] += 1
+        else:
+            with self._meta:
+                self._acquisitions += 1
+        stack.append({"lock": lock, "t0": time.monotonic(), "count": 1})
+
+    def _on_release(self, lock: _InstrumentedLock, full: bool = False, waiting: bool = False) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            entry = stack[i]
+            if entry["lock"] is not lock:
+                continue
+            if not full and entry["count"] > 1:
+                entry["count"] -= 1
+                return
+            held_s = time.monotonic() - entry["t0"]
+            del stack[i]
+            if held_s >= self.held_threshold_s > 0:
+                self._record(
+                    self._long_holds,
+                    {
+                        "lock": lock.name,
+                        "held_ms": round(held_s * 1000.0, 3),
+                        "thread": threading.current_thread().name,
+                        "site": _caller_site(),
+                    },
+                )
+            if waiting and stack:
+                # Condition.wait while still holding OTHER locks: runtime JL010.
+                self.note_blocking(f"{lock.name}.wait", kind="condition-wait-under-lock")
+            return
+
+    # ------------------------------------------------------------ observations
+    def note_blocking(self, desc: str, kind: str = "blocking-under-lock") -> None:
+        stack = self._stack()
+        if not stack:
+            return
+        self._record(
+            self._blocking,
+            {
+                "call": desc,
+                "kind": kind,
+                "held": [e["lock"].name for e in stack],
+                "thread": threading.current_thread().name,
+                "site": _caller_site(),
+            },
+        )
+
+    def _record(self, bucket: List[Dict[str, Any]], item: Dict[str, Any]) -> None:
+        with self._meta:
+            if len(bucket) < _MAX_EVENTS:
+                bucket.append(item)
+
+    # ---------------------------------------------------------------- reports
+    def cycles(self) -> List[List[str]]:
+        with self._meta:
+            graph: Dict[str, set] = {}
+            for (a, b), _ in self._edges.items():
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        return _cycles(graph)
+
+    def counts(self) -> Dict[str, int]:
+        cycles = self.cycles()
+        with self._meta:
+            return {
+                "locks_created": self._locks_created,
+                "acquisitions": self._acquisitions,
+                "edges": len(self._edges),
+                "cycles": len(cycles),
+                "long_holds": len(self._long_holds),
+                "blocking_under_lock": len(self._blocking),
+            }
+
+    def report(self) -> Dict[str, Any]:
+        cycles = self.cycles()
+        with self._meta:
+            edges = [
+                {"from": a, "to": b, **rec} for (a, b), rec in sorted(self._edges.items())
+            ]
+            long_holds = list(self._long_holds)
+            blocking = list(self._blocking)
+        return {
+            "counts": self.counts(),
+            "cycles": cycles,
+            "edges": edges,
+            "long_holds": long_holds,
+            "blocking": blocking,
+        }
+
+    def dump(self, reason: str = "report") -> Optional[str]:
+        """Write the JSONL report into ``<log_dir>/races/`` and merge headline
+        counts into whatever telemetry planes are active.  Never raises."""
+        rep = self.report()
+        path: Optional[str] = None
+        try:
+            races_dir = os.path.join(self.log_dir or ".", "races")
+            os.makedirs(races_dir, exist_ok=True)
+            path = os.path.join(races_dir, f"races_{os.getpid()}.jsonl")
+            with open(path, "w") as f:
+                f.write(json.dumps({"kind": "summary", "reason": reason, **rep["counts"]}) + "\n")
+                for cyc in rep["cycles"]:
+                    f.write(json.dumps({"kind": "cycle", "locks": cyc}) + "\n")
+                for edge in rep["edges"]:
+                    f.write(json.dumps({"kind": "edge", **edge}) + "\n")
+                for item in rep["long_holds"]:
+                    f.write(json.dumps({"kind": "long_hold", **item}) + "\n")
+                for item in rep["blocking"]:
+                    f.write(json.dumps({"kind": "blocking", **item}) + "\n")
+        except OSError as e:  # pragma: no cover - disk full etc.
+            print(f"race detector: could not write report: {e}", file=sys.stderr)
+            path = None
+        try:  # flight recorder + fleet merge (best effort, planes may be down)
+            from sheeprl_tpu.obs import flight_recorder
+
+            flight_recorder.record_event("race_report", reason=reason, **rep["counts"])
+            from sheeprl_tpu.obs import fleet as obs_fleet
+
+            exporter = obs_fleet.get_active()
+            if exporter is not None:
+                for key in ("cycles", "long_holds", "blocking_under_lock", "edges"):
+                    exporter.gauge(f"race_{key}", float(rep["counts"][key]))
+        except Exception:  # pragma: no cover - telemetry must never break the run
+            pass
+        return path
+
+
+# ----------------------------------------------------------------- installing
+_ACTIVE: Optional[RaceDetector] = None
+_INSTALL_LOCK = _REAL_LOCK()
+
+
+def get_active() -> Optional[RaceDetector]:
+    return _ACTIVE
+
+
+def install(detector: RaceDetector) -> Optional[RaceDetector]:
+    """Patch the ``threading`` lock factories (and ``time.sleep``) so locks
+    created from now on report to ``detector``.  Returns the previous one."""
+    global _ACTIVE
+    with _INSTALL_LOCK:
+        prev = _ACTIVE
+        _ACTIVE = detector
+
+        def _lock() -> Any:
+            det = _ACTIVE
+            return det.make_lock() if det is not None else _REAL_LOCK()
+
+        def _rlock() -> Any:
+            det = _ACTIVE
+            return det.make_rlock() if det is not None else _REAL_RLOCK()
+
+        def _condition(lock: Any = None) -> Any:
+            det = _ACTIVE
+            return det.make_condition(lock) if det is not None else _REAL_CONDITION(lock)
+
+        def _sleep(seconds: float) -> None:
+            det = _ACTIVE
+            if det is not None:
+                det.note_blocking(f"time.sleep({seconds})")
+            _REAL_SLEEP(seconds)
+
+        threading.Lock = _lock  # type: ignore[assignment]
+        threading.RLock = _rlock  # type: ignore[assignment]
+        threading.Condition = _condition  # type: ignore[assignment]
+        time.sleep = _sleep  # type: ignore[assignment]
+    return prev
+
+
+def uninstall() -> Optional[RaceDetector]:
+    """Restore the real factories; already-created instrumented locks keep
+    working (their inner locks are real), they just stop growing the graph
+    once the detector is detached."""
+    global _ACTIVE
+    with _INSTALL_LOCK:
+        prev = _ACTIVE
+        _ACTIVE = None
+        threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+        threading.RLock = _REAL_RLOCK  # type: ignore[assignment]
+        threading.Condition = _REAL_CONDITION  # type: ignore[assignment]
+        time.sleep = _REAL_SLEEP  # type: ignore[assignment]
+    return prev
+
+
+def dump_active(reason: str = "report") -> Optional[str]:
+    det = _ACTIVE
+    return det.dump(reason) if det is not None else None
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_VAR, "0") not in ("", "0")
+
+
+def maybe_install(cfg: Any = None, log_dir: Optional[str] = None) -> Optional[RaceDetector]:
+    """Gate + install, mirroring the flight recorder boundary: env var wins,
+    else ``analysis.race_detect`` in the run config.  Returns the detector (or
+    ``None`` when disabled) — callers pair it with :func:`dump_active` +
+    :func:`uninstall` in their shutdown path."""
+    enabled = enabled_by_env()
+    hold_ms = 200.0
+    if cfg is not None:
+        try:
+            analysis_cfg = cfg.get("analysis", {}) or {}
+            enabled = enabled or bool(analysis_cfg.get("race_detect", False))
+            hold_ms = float(analysis_cfg.get("race_hold_ms", hold_ms))
+        except Exception:
+            pass
+    if not enabled:
+        return None
+    detector = RaceDetector(log_dir=log_dir, held_threshold_ms=hold_ms)
+    install(detector)
+    return detector

@@ -196,6 +196,15 @@ def run_algorithm(cfg: DotDict) -> None:
     install_signal_handlers(grace_seconds=cfg.get("fault", {}).get("grace_seconds", 0))
     fault_chaos.install(cfg)
 
+    # Concurrency race detector (jaxlint-threads runtime half,
+    # sheeprl_tpu/analysis/threads/runtime.py): opt-in lock instrumentation
+    # installed at the same boundary as chaos/signals so every lock the run
+    # creates afterwards is observed; its JSONL report lands in
+    # <log_dir>/races/ at the exit/crash boundary below.
+    from sheeprl_tpu.analysis.threads import runtime as race_runtime
+
+    race_detector = race_runtime.maybe_install(cfg)
+
     maybe_init_distributed(cfg.get("mesh", {}))
     ctx = make_mesh_context(cfg)
 
@@ -227,6 +236,17 @@ def run_algorithm(cfg: DotDict) -> None:
         obs_fleet.close_active(error=exc)
         raise
     finally:
+        # Race report first: its headline counts merge into the flight recorder
+        # and the fleet exporter's final flush before those planes close.  The
+        # run's log dir is only resolved inside the entry point (the logger owns
+        # the version_N subdir), so the detector borrows the flight recorder's.
+        if race_detector is not None:
+            if race_detector.log_dir is None:
+                recorder = flight_recorder.get_active()
+                if recorder is not None:
+                    race_detector.log_dir = recorder.log_dir
+            race_runtime.dump_active("run-end")
+            race_runtime.uninstall()
         flight_recorder.install(None)
         obs_fleet.close_active()
 

@@ -106,20 +106,33 @@ class ChannelWeightPublisher:
     def publish(self, params: Any, *, grad_step: int, policy_step: int) -> Dict[str, int]:
         import jax
 
+        # THE one host round-trip — outside the lock: device_get parks the
+        # thread until device work drains, and nothing it reads is shared.
+        host_params = jax.device_get(params)
         with self._lock:
             self.seq += 1
             stamp = make_stamp(self.seq, grad_step, policy_step)
-            host_params = jax.device_get(params)  # THE one host round-trip
             self._last = (host_params, stamp)
-            # t_pub rides transport meta, NOT the stamp: the stamp's
-            # {seq, grad_step, policy_step} shape is a pinned contract, while
-            # t_pub is fleet-telemetry lineage (publish→apply latency) that the
-            # consumer folds into its local copy of the stamp.
-            for ch in list(self._channels()):
-                try:
-                    self.bytes_published += ch.send(PARAMS_KIND, host_params, stamp=stamp, t_pub=time.time())
-                except ChannelClosed:
-                    pass  # dead actor: its respawn gets a welcome publish instead
+            channels = list(self._channels())
+        # Sends are lock-free (JL010): a backpressured actor socket must not
+        # convoy maybe_welcome() callers on the inbox accept thread.  Wire
+        # order between racing sends is therefore unguaranteed — the consumer
+        # keeps the max-seq publish (sebulba ``_pickup_params``), so an
+        # overtaken older send is skipped, never applied.
+        #
+        # t_pub rides transport meta, NOT the stamp: the stamp's
+        # {seq, grad_step, policy_step} shape is a pinned contract, while
+        # t_pub is fleet-telemetry lineage (publish→apply latency) that the
+        # consumer folds into its local copy of the stamp.
+        sent = 0
+        for ch in channels:
+            try:
+                sent += ch.send(PARAMS_KIND, host_params, stamp=stamp, t_pub=time.time())
+            except ChannelClosed:
+                pass  # dead actor: its respawn gets a welcome publish instead
+        if sent:
+            with self._lock:
+                self.bytes_published += sent
         return stamp
 
     def maybe_welcome(self, ch: Channel) -> None:
@@ -128,10 +141,13 @@ class ChannelWeightPublisher:
         exist.  No-op before the first publish (every actor builds bit-identical
         init params from the shared seed)."""
         with self._lock:
-            if self._last is None:
-                return
-            host_params, stamp = self._last
-            try:
-                self.bytes_published += ch.send(PARAMS_KIND, host_params, stamp=stamp, t_pub=time.time())
-            except ChannelClosed:
-                pass
+            last = self._last
+        if last is None:
+            return
+        host_params, stamp = last
+        try:
+            sent = ch.send(PARAMS_KIND, host_params, stamp=stamp, t_pub=time.time())
+        except ChannelClosed:
+            return
+        with self._lock:
+            self.bytes_published += sent

@@ -217,13 +217,24 @@ def _stamp_of(meta: Dict[str, Any]) -> Dict[str, Any]:
     return stamp
 
 
+def _freshest(
+    latest: Optional[Tuple[Any, Dict[str, Any]]], candidate: Tuple[Any, Dict[str, Any]]
+) -> Tuple[Any, Dict[str, Any]]:
+    """Max-seq wins, not last-arrived: publisher sends are lock-free, so a
+    welcome publish can overtake a newer broadcast on the wire — applying it
+    would regress params."""
+    if latest is None or int(candidate[1].get("seq", 0)) >= int(latest[1].get("seq", 0)):
+        return candidate
+    return latest
+
+
 def _pickup_params(ch: Channel, latest: Optional[Tuple[Any, Dict[str, Any]]]):
     """Drain every pending publish, keep only the freshest (actors may skip
     publishes, never act on older-than-latest params)."""
     while ch.poll(0):
         kind, meta, payload = ch.recv()
         if kind == PARAMS_KIND:
-            latest = (payload, _stamp_of(meta))
+            latest = _freshest(latest, (payload, _stamp_of(meta)))
     return latest
 
 
@@ -239,7 +250,7 @@ def _await_params(ch: Channel, last_seq: int, timeout_s: float):
             raise TimeoutError(f"no param publish newer than seq={last_seq} within {timeout_s}s")
         kind, meta, payload = ch.recv(timeout=remaining)
         if kind == PARAMS_KIND:
-            latest = (payload, _stamp_of(meta))
+            latest = _freshest(latest, (payload, _stamp_of(meta)))
     return _pickup_params(ch, latest)
 
 

@@ -170,11 +170,16 @@ class Channel:
                 raise ChannelClosed("send on closed channel")
             try:
                 for c in chunks:
+                    # jaxlint: disable=JL010 — blocking send under _send_lock is
+                    # the framing contract itself: the lock exists to keep one
+                    # message's chunks contiguous on the wire; writers queueing
+                    # on it is the documented TCP-backpressure flow control.
                     self._sock.sendall(c)
             except (BrokenPipeError, ConnectionResetError, OSError) as e:
                 self._mark_closed()
                 raise ChannelClosed(str(e)) from e
-        self.bytes_sent += n
+            # inside the lock: concurrent senders would lose += updates
+            self.bytes_sent += n
         return n
 
     # ------------------------------------------------------------------ recv

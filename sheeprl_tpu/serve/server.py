@@ -94,6 +94,12 @@ class _Endpoint:
         self.seed = seed
         self.state_cache = None  # SessionStateCache for stateful policies
         self.dispatch_counter = 0
+        # accepted is bumped by one reader thread per client connection; an
+        # unguarded += is a read-modify-write that loses updates (JL008), which
+        # would silently break the accepted == replied + dropped summary
+        # invariant.  replied/dropped/dispatch_counter have a single writer
+        # (the endpoint's dispatcher thread) and stay lock-free.
+        self.stats_lock = threading.Lock()
         self.accepted = 0
         self.replied = 0
         self.dropped = 0
@@ -140,6 +146,7 @@ class PolicyServer:
         self.startup_seconds = 0.0
         self.precompile_seconds = 0.0
         self.watchdog = None
+        self._stats_lock = threading.Lock()  # guards rejected_draining (readers race)
         self.rejected_draining = 0
         self._fleet = None  # FleetExporter, attached in run()
 
@@ -364,7 +371,8 @@ class PolicyServer:
             return
         req_id = meta.get("req_id")
         if self._draining:
-            self.rejected_draining += 1
+            with self._stats_lock:
+                self.rejected_draining += 1
             ch.send("draining", req_id=req_id)
             return
         spec = str(meta.get("policy", ""))
@@ -391,7 +399,8 @@ class PolicyServer:
                 reset=bool(meta.get("reset", False)),
             )
         )
-        ep.accepted += 1
+        with ep.stats_lock:
+            ep.accepted += 1
 
     # --------------------------------------------------------------- dispatcher
     def _dispatch_loop(self, ep: _Endpoint) -> None:

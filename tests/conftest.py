@@ -23,6 +23,33 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _race_detector_session():
+    """jaxlint-threads runtime half under pytest: when the CI job exports
+    ``SHEEPRL_TPU_RACE_DETECT=1`` (the concurrency suites — test_distributed /
+    test_serve / test_obs — run once this way), every lock the tests create is
+    instrumented; the session ends by dumping the JSONL race report into
+    ``$SHEEPRL_TPU_RACE_DIR`` (default: the launch directory) where the CI step
+    asserts zero lock-order cycles.  A no-op without the env var."""
+    if os.environ.get("SHEEPRL_TPU_RACE_DETECT", "0") in ("", "0"):
+        yield
+        return
+    from sheeprl_tpu.analysis.threads import runtime as race_runtime
+
+    detector = race_runtime.RaceDetector(
+        log_dir=os.environ.get("SHEEPRL_TPU_RACE_DIR") or os.getcwd(),
+        held_threshold_ms=float(os.environ.get("SHEEPRL_TPU_RACE_HOLD_MS", "500")),
+    )
+    race_runtime.install(detector)
+    try:
+        yield
+    finally:
+        race_runtime.uninstall()
+        path = detector.dump("pytest-session")
+        counts = detector.counts()
+        print(f"\nrace detector: {counts} -> {path}")
+
+
 @pytest.fixture()
 def tmp_logs(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)

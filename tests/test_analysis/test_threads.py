@@ -1,0 +1,707 @@
+"""jaxlint-threads: one positive and one negative fixture per rule (JL008–JL012),
+baseline / suppression / CLI exit-code paths, and the runtime lock-order detector."""
+
+import json
+import textwrap
+import threading
+
+import pytest
+
+from sheeprl_tpu.analysis.engine import load_baseline, run_lint, write_baseline
+from sheeprl_tpu.analysis.threads import default_thread_rules
+from sheeprl_tpu.analysis.threads import runtime as race_runtime
+from sheeprl_tpu.analysis.threads.__main__ import main as threads_main
+from tests.test_analysis.conftest import rule_ids
+
+
+@pytest.fixture()
+def tlint(tmp_path):
+    """tlint(source, select=[...]) -> concurrency findings for one module."""
+
+    def _lint(source, select=None):
+        mod = tmp_path / "snippet.py"
+        mod.write_text(textwrap.dedent(source))
+        return run_lint([mod], rules=default_thread_rules(select), root=tmp_path)
+
+    return _lint
+
+
+# ------------------------------------------------------------------------- JL008
+def test_jl008_positive_unguarded_cross_method(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class Racy:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._work, daemon=True)
+                self._t.start()
+
+            def _work(self):
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+        """
+    )
+    assert "JL008" in rule_ids(findings)
+
+
+def test_jl008_negative_guarded(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._work, daemon=True)
+                self._t.start()
+
+            def _work(self):
+                with self._lock:
+                    self.count += 1
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+        """
+    )
+    assert "JL008" not in rule_ids(findings)
+
+
+def test_jl008_positive_multi_instance_rmw(tlint):
+    # one reader thread per accepted connection: the *same* method races with
+    # itself across instances of the thread, so a bare += is a lost update
+    findings = tlint(
+        """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self.accepted = 0
+
+            def serve(self):
+                while True:
+                    t = threading.Thread(target=self._reader, daemon=True)
+                    t.start()
+
+            def _reader(self):
+                self.accepted += 1
+        """
+    )
+    assert "JL008" in rule_ids(findings)
+
+
+def test_jl008_negative_init_only_write(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class InitOnly:
+            def __init__(self):
+                self.mode = "idle"
+                self._t = threading.Thread(target=self._work, daemon=True)
+                self._t.start()
+
+            def _work(self):
+                print(self.mode)
+        """
+    )
+    assert "JL008" not in rule_ids(findings)
+
+
+# ------------------------------------------------------------------------- JL009
+def test_jl009_positive_inverted_with(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class Inverted:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """
+    )
+    assert "JL009" in rule_ids(findings)
+
+
+def test_jl009_positive_multi_item_with_ordering(tlint):
+    # `with a, b` acquires left-to-right: reversing the items is an inversion
+    findings = tlint(
+        """
+        import threading
+
+        def multi_item():
+            a = threading.Lock()
+            b = threading.Lock()
+            with a, b:
+                pass
+            with b, a:
+                pass
+        """
+    )
+    assert "JL009" in rule_ids(findings)
+
+
+def test_jl009_positive_cross_method_edge(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class CrossMethod:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def left(self):
+                with self._a:
+                    self.helper()
+
+            def helper(self):
+                with self._b:
+                    pass
+
+            def right(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """
+    )
+    assert "JL009" in rule_ids(findings)
+
+
+def test_jl009_negative_rlock_reentrancy(tlint):
+    # re-entering the same RLock through a self-call is not a cycle
+    findings = tlint(
+        """
+        import threading
+
+        class Reentrant:
+            def __init__(self):
+                self._r = threading.RLock()
+
+            def outer(self):
+                with self._r:
+                    self.inner()
+
+            def inner(self):
+                with self._r:
+                    pass
+        """
+    )
+    assert "JL009" not in rule_ids(findings)
+
+
+def test_jl009_negative_consistent_order(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """
+    )
+    assert "JL009" not in rule_ids(findings)
+
+
+def test_jl009_positive_plain_lock_self_deadlock(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class SelfDeadlock:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """
+    )
+    assert "JL009" in rule_ids(findings)
+
+
+# ------------------------------------------------------------------------- JL010
+def test_jl010_positive_sleep_and_blocking_get(tlint):
+    findings = tlint(
+        """
+        import queue
+        import threading
+        import time
+
+        class SleepUnderLock:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+                    self._q.get()
+        """,
+        select=["JL010"],
+    )
+    assert len(findings) == 2
+
+
+def test_jl010_negative_nonblocking_queue_ops(tlint):
+    findings = tlint(
+        """
+        import queue
+        import threading
+
+        class NonBlocking:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def ok(self):
+                with self._lock:
+                    self._q.get(block=False)
+                    self._q.get_nowait()
+                    self._q.put_nowait(1)
+        """,
+        select=["JL010"],
+    )
+    assert findings == []
+
+
+def test_jl010_negative_condition_own_lock(tlint):
+    # Condition.wait releases its own backing lock: not blocking-under-lock
+    findings = tlint(
+        """
+        import threading
+
+        class CondOwn:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait()
+        """,
+        select=["JL010"],
+    )
+    assert findings == []
+
+
+def test_jl010_positive_condition_wait_with_other_lock(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class CondOther:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self.ready = False
+
+            def wait_ready(self):
+                with self._other:
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait()
+        """,
+        select=["JL010"],
+    )
+    assert len(findings) == 1
+
+
+# ------------------------------------------------------------------------- JL011
+def test_jl011_positive_never_joined_nondaemon(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class NoJoin:
+            def spawn(self):
+                t = threading.Thread(target=self.spin)
+                t.start()
+
+            def spin(self):
+                for _ in range(3):
+                    pass
+        """,
+        select=["JL011"],
+    )
+    assert "JL011" in rule_ids(findings)
+
+
+def test_jl011_positive_unstoppable_loop(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class Unstoppable:
+            def __init__(self):
+                self._t = threading.Thread(target=self._spin, daemon=True)
+                self._t.start()
+
+            def _spin(self):
+                while True:
+                    pass
+        """,
+        select=["JL011"],
+    )
+    assert "JL011" in rule_ids(findings)
+
+
+def test_jl011_positive_start_before_dependent_attr(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class EarlyStart:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+                self.needed = 5
+
+            def _run(self):
+                print(self.needed)
+        """,
+        select=["JL011"],
+    )
+    assert "JL011" in rule_ids(findings)
+
+
+def test_jl011_negative_joined_daemon_with_stop(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class Clean:
+            def __init__(self):
+                self._stop = threading.Event()
+                self.needed = 5
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                while not self._stop.is_set():
+                    print(self.needed)
+
+            def close(self):
+                self._stop.set()
+                self._t.join()
+        """,
+        select=["JL011"],
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------------- JL012
+def test_jl012_positive_wait_without_loop(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class BadWait:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cond:
+                    if not self.ready:
+                        self._cond.wait()
+        """,
+        select=["JL012"],
+    )
+    assert "JL012" in rule_ids(findings)
+
+
+def test_jl012_negative_predicate_loop_and_event(tlint):
+    findings = tlint(
+        """
+        import threading
+
+        class GoodWait:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._ev = threading.Event()
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait()
+
+            def wait_for(self):
+                with self._cond:
+                    self._cond.wait_for(lambda: self.ready)
+
+            def wait_event(self):
+                self._ev.wait()
+        """,
+        select=["JL012"],
+    )
+    assert findings == []
+
+
+# --------------------------------------------------- suppression / baseline / CLI
+_INVERTED_SRC = """
+import threading
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+
+def test_suppression_comment(tmp_path):
+    src = textwrap.dedent(
+        """
+        import threading
+        import time
+
+        class Suppressed:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    # jaxlint: disable=JL010 -- intentional: test fixture
+                    time.sleep(1.0)
+        """
+    )
+    mod = tmp_path / "snippet.py"
+    mod.write_text(src)
+    findings = run_lint([mod], rules=default_thread_rules(["JL010"]), root=tmp_path)
+    assert findings == []
+
+
+def test_baseline_round_trip(tmp_path):
+    mod = tmp_path / "snippet.py"
+    mod.write_text(textwrap.dedent(_INVERTED_SRC))
+    rules = default_thread_rules(["JL009"])
+    findings = run_lint([mod], rules=rules, root=tmp_path)
+    assert findings
+
+    base_path = tmp_path / "threads.baseline"
+    write_baseline(findings, str(base_path))
+    baseline = load_baseline(str(base_path))
+    again = run_lint([mod], rules=rules, baseline=baseline, root=tmp_path)
+    assert again == []
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(textwrap.dedent(_INVERTED_SRC))
+    base = tmp_path / "threads.baseline"
+
+    assert threads_main(["--no-baseline", "-q", str(clean)]) == 0
+    assert threads_main(["--no-baseline", "-q", str(dirty)]) == 1
+    assert threads_main(["--select", "JL999", str(clean)]) == 2
+
+    # --write-baseline accepts the current findings; the next run is green
+    assert threads_main(["--write-baseline", "--baseline", str(base), "-q", str(dirty)]) == 0
+    assert threads_main(["--baseline", str(base), "-q", str(dirty)]) == 0
+    capsys.readouterr()
+
+
+def test_repo_is_clean_against_committed_baseline():
+    # the acceptance bar: jaxlint-threads over the package exits 0
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[2]
+    assert threads_main(
+        ["--baseline", str(root / "threads.baseline"), "--root", str(root), "-q", str(root / "sheeprl_tpu")]
+    ) == 0
+
+
+# ------------------------------------------------------------- runtime detector
+def test_runtime_detects_two_thread_lock_order_inversion(tmp_path):
+    det = race_runtime.RaceDetector(log_dir=str(tmp_path))
+    a = det.make_lock()
+    b = det.make_lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba)
+    t2.start()
+    t2.join()
+
+    cycles = det.cycles()
+    assert len(cycles) == 1
+    assert set(cycles[0]) == {a.name, b.name}
+    counts = det.counts()
+    assert counts["cycles"] == 1
+    assert counts["edges"] == 2
+
+    path = det.dump("test")
+    lines = [json.loads(line) for line in open(path)]
+    kinds = [rec["kind"] for rec in lines]
+    assert kinds[0] == "summary" and lines[0]["cycles"] == 1
+    assert "cycle" in kinds and "edge" in kinds
+
+
+def test_runtime_rlock_reentry_is_not_a_cycle():
+    det = race_runtime.RaceDetector()
+    r = det.make_rlock()
+    with r:
+        with r:
+            pass
+    assert det.cycles() == []
+    assert det.counts()["edges"] == 0
+
+
+def test_runtime_consistent_order_no_cycle():
+    det = race_runtime.RaceDetector()
+    a, b = det.make_lock(), det.make_lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert det.cycles() == []
+    assert det.counts()["edges"] == 1
+
+
+def test_runtime_long_hold_recorded():
+    det = race_runtime.RaceDetector(held_threshold_ms=1.0)
+    lock = det.make_lock()
+    with lock:
+        threading.Event().wait(0.01)
+    rep = det.report()
+    assert len(rep["long_holds"]) == 1
+    assert rep["long_holds"][0]["lock"] == lock.name
+
+
+def test_runtime_note_blocking_only_under_lock():
+    det = race_runtime.RaceDetector()
+    det.note_blocking("time.sleep(1)")  # nothing held: ignored
+    lock = det.make_lock()
+    with lock:
+        det.note_blocking("time.sleep(1)")
+    blocking = det.report()["blocking"]
+    assert len(blocking) == 1
+    assert blocking[0]["held"] == [lock.name]
+
+
+def test_runtime_condition_wait_interop():
+    # a real threading.Condition over an instrumented lock: wait/notify works
+    # and the held-set is exact afterwards (the Condition private protocol)
+    det = race_runtime.RaceDetector()
+    lock = det.make_lock()
+    cond = race_runtime._REAL_CONDITION(lock)
+    ready = []
+
+    def waiter():
+        with cond:
+            while not ready:
+                cond.wait(timeout=5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cond:
+        ready.append(1)
+        cond.notify()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert det.held_names() == []
+
+
+def test_runtime_install_uninstall_round_trip():
+    det = race_runtime.RaceDetector()
+    prev = race_runtime.install(det)
+    try:
+        assert race_runtime.get_active() is det
+        lock = threading.Lock()
+        assert isinstance(lock, race_runtime._InstrumentedLock)
+        with lock:
+            pass
+        assert det.counts()["acquisitions"] >= 1
+    finally:
+        # compose with a session-installed detector (CI race runs): restore it
+        if prev is not None:
+            race_runtime.install(prev)
+        else:
+            race_runtime.uninstall()
+    if prev is None:
+        assert threading.Lock is race_runtime._REAL_LOCK
+        assert race_runtime.get_active() is None
+
+
+def test_runtime_env_gate(monkeypatch):
+    monkeypatch.delenv(race_runtime.ENV_VAR, raising=False)
+    assert not race_runtime.enabled_by_env()
+    assert race_runtime.maybe_install() is None
+    monkeypatch.setenv(race_runtime.ENV_VAR, "0")
+    assert not race_runtime.enabled_by_env()
+    monkeypatch.setenv(race_runtime.ENV_VAR, "1")
+    assert race_runtime.enabled_by_env()
+
+
+def test_runtime_maybe_install_from_config(tmp_path, monkeypatch):
+    monkeypatch.delenv(race_runtime.ENV_VAR, raising=False)
+    prev = race_runtime.get_active()
+    cfg = {"analysis": {"race_detect": True, "race_hold_ms": 50.0}}
+    det = race_runtime.maybe_install(cfg, log_dir=str(tmp_path))
+    try:
+        assert det is not None
+        assert det.held_threshold_s == pytest.approx(0.05)
+    finally:
+        if prev is not None:
+            race_runtime.install(prev)
+        else:
+            race_runtime.uninstall()

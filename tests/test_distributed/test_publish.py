@@ -131,3 +131,79 @@ def test_channel_publisher_survives_dead_channel():
     assert pub.seq == 50
     chans[0].close()
     lis.close()
+
+
+# ------------------------------------------- concurrency contract (jaxlint JL010)
+def test_channel_publisher_concurrent_publish_and_welcome():
+    """The publisher's ``device_get`` and socket sends happen OUTSIDE its lock
+    (JL010 fix): racing publishes and welcomes must still hand every consumer a
+    monotonically-applicable stream — the consumer's max-seq guard keeps the
+    freshest params even when an older welcome overtakes a newer broadcast."""
+    from sheeprl_tpu.distributed.sebulba import _pickup_params
+
+    lis = Listener()
+    learner_side = []
+
+    def accept_one():
+        learner_side.append(lis.accept(5.0))
+
+    t = threading.Thread(target=accept_one)
+    t.start()
+    actor = connect("127.0.0.1", lis.port, timeout_s=5.0)
+    t.join()
+
+    pub = ChannelWeightPublisher(lambda: list(learner_side))
+    params = {"w": jnp.ones((8, 8))}
+    n_threads, n_each = 4, 5
+    errors = []
+
+    def spam(i):
+        try:
+            for _ in range(n_each):
+                pub.publish(params, grad_step=i, policy_step=i)
+                pub.maybe_welcome(learner_side[0])
+        except Exception as e:  # pragma: no cover - the assertion is no-raise
+            errors.append(e)
+
+    threads = [threading.Thread(target=spam, args=(i,)) for i in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    assert errors == []
+    assert pub.seq == n_threads * n_each  # no lost seq increments
+    assert pub.bytes_published > 0
+
+    # consumer side: drain everything; the max-seq guard must settle on the
+    # globally freshest publish regardless of wire arrival order
+    import time as _time
+
+    deadline = _time.monotonic() + 5.0
+    latest = None
+    while _time.monotonic() < deadline:
+        latest = _pickup_params(actor, latest)
+        if latest is not None and int(latest[1]["seq"]) == pub.seq:
+            break
+        _time.sleep(0.01)
+    assert latest is not None
+    assert int(latest[1]["seq"]) == pub.seq
+
+    actor.close()
+    for ch in learner_side:
+        ch.close()
+    lis.close()
+
+
+def test_freshest_prefers_max_seq_not_last_arrived():
+    from sheeprl_tpu.distributed.sebulba import _freshest
+
+    newer = ("p2", {"seq": 7})
+    older = ("p1", {"seq": 3})
+    assert _freshest(None, older) is older
+    assert _freshest(older, newer) is newer
+    # the regression: an out-of-order older arrival must NOT replace the newer
+    assert _freshest(newer, older) is newer
+    # equal seq: the later arrival wins (welcome re-send of the same publish)
+    resend = ("p2b", {"seq": 7})
+    assert _freshest(newer, resend) is resend

@@ -135,3 +135,35 @@ def test_no_dropped_metrics_strict_stays_green(tmp_path):
     base = _report(tmp_path, "BENCH_a.json", {"sps": (100.0, "grad_steps/s")})
     new = _report(tmp_path, "BENCH_b.json", {"sps": (102.0, "grad_steps/s"), "extra": (1.0, "x")})
     assert bench_compare.main([base, new, "--strict"]) == 0
+
+
+def test_race_detect_overhead_direction_pin_and_row(tmp_path):
+    """race_detect_overhead_pct (benchmarks/race_detect_bench.py) is an overhead
+    percentage: it regresses when it RISES, pinned lower-better by exact name
+    (no prefix pin covers race_*; the unit text alone would not flip it)."""
+    assert bench_compare.lower_is_better("race_detect_overhead_pct", "% wall-time overhead") is True
+
+    base = _report(tmp_path, "BENCH_a.json", {"race_detect_overhead_pct": (5.0, "%")})
+    new = _report(tmp_path, "BENCH_b.json", {"race_detect_overhead_pct": (12.0, "%")})
+    report = bench_compare.compare(base, new, threshold=0.10)
+    assert report["regressions"] == ["race_detect_overhead_pct"]
+    # improvement direction: dropping overhead is NOT a regression
+    report = bench_compare.compare(new, base, threshold=0.10)
+    assert report["regressions"] == []
+
+
+def test_race_detect_bench_row_shape():
+    """A tiny in-process run of the bench: the row carries the pinned metric
+    name, a non-negative value, and the detector's bookkeeping counters — and
+    the workload itself is cycle-free (consistent lock order)."""
+    spec = importlib.util.spec_from_file_location(
+        "race_detect_bench",
+        pathlib.Path(__file__).resolve().parents[2] / "benchmarks" / "race_detect_bench.py",
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    row = bench.run_bench(items=400, n_threads=2, repeats=1, work_us=10.0)
+    assert row["metric"] == "race_detect_overhead_pct"
+    assert row["value"] >= 0.0
+    assert row["acquisitions"] > 0
+    assert row["cycles"] == 0

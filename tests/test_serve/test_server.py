@@ -255,3 +255,36 @@ def test_preemption_drains_and_replies_to_everything_accepted(registry):
     assert summary["accepted"] == summary["replied"]
     assert summary["dropped"] == 0
     assert summary["replied"] >= sum(replies)
+
+
+# -------------------------------------------- stats counters (jaxlint JL008 fix)
+def test_endpoint_accepted_counter_is_lock_guarded():
+    """``accepted`` is bumped by one reader thread per client connection — a bare
+    ``+=`` loses updates under contention.  Pin the lock's existence and the
+    guarded-increment contract; the e2e suites pin accepted == replied."""
+    import inspect
+
+    from sheeprl_tpu.serve.server import PolicyServer, _Endpoint
+
+    ep = _Endpoint("m", 1, policy=None, compiled=None, ladder=[], queue_depth=4, seed=0)
+    assert hasattr(ep, "stats_lock")
+
+    n_threads, n_each = 8, 200
+
+    def bump():
+        for _ in range(n_each):
+            with ep.stats_lock:
+                ep.accepted += 1
+
+    threads = [threading.Thread(target=bump) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ep.accepted == n_threads * n_each
+
+    # the reader path actually uses the guards (regression against silently
+    # dropping the `with` blocks in a refactor)
+    src = inspect.getsource(PolicyServer._handle)
+    assert "with ep.stats_lock:" in src
+    assert "with self._stats_lock:" in src
