@@ -44,25 +44,11 @@ os.environ.setdefault("SHEEPRL_TPU_QUIET", "1")
 # wall-clock (docstring above): ~98,976 gradient steps / 14 h on 1× RTX 3080.
 BASELINE_E2E_GRAD_STEPS_PER_SEC = 1.963
 
-# Peak dense bf16 FLOP/s per chip (public figures).
-PEAK_FLOPS = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12 / 2,  # per-chip figure is per 2 cores; one jax device = 1 chip
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,  # v5e's device_kind
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,  # v6e/Trillium's device_kind
-    "TPU v6e": 918e12,
-}
-
-
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for name, peak in PEAK_FLOPS.items():
-        if kind.startswith(name):
-            return peak
-    return 275e12  # assume v4 when unknown
+# Peak dense bf16 FLOP/s per chip: single source of truth is the perf
+# attribution plane (``sheeprl_tpu/obs/perf.py``); re-exported here under the
+# historical names so downstream scripts importing ``bench.PEAK_FLOPS`` /
+# ``bench._peak_flops`` keep working.
+from sheeprl_tpu.obs.perf import PEAK_FLOPS, peak_flops as _peak_flops  # noqa: E402
 
 
 def bench_train_only(size: str = "S", batch: int = 16):
@@ -111,14 +97,15 @@ def bench_train_only(size: str = "S", batch: int = 16):
     key = jax.random.PRNGKey(0)
     update_target = jnp.asarray(True)
 
-    # FLOPs of one compiled step (XLA's own estimate) for the MFU figure.
+    # FLOPs of one compiled step (XLA's own estimate) for the MFU figure — the
+    # same ``analyze_compiled`` the in-run perf plane uses, so this bench and
+    # ``Perf/mfu`` agree by construction (pinned in tests/test_obs/test_perf.py).
+    from sheeprl_tpu.obs import perf as obs_perf
+
     flops_per_step = 0.0
     try:
         compiled = train_jit.lower(params, opt_states, moments, data, key, update_target).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops_per_step = float(cost.get("flops", 0.0))
+        flops_per_step, _ = obs_perf.analyze_compiled(compiled)
     except Exception:
         pass
 
@@ -141,7 +128,7 @@ def bench_train_only(size: str = "S", batch: int = 16):
     gsps = n_steps / elapsed
     mfu = 0.0
     if flops_per_step > 0:
-        mfu = flops_per_step * gsps / _peak_flops(jax.devices()[0])
+        mfu = obs_perf.mfu_from_flops(flops_per_step, gsps, jax.devices()[0])
     return gsps, mfu
 
 
@@ -399,6 +386,28 @@ def bench_precision() -> list:
     ]
 
 
+def bench_perf_overhead() -> list:
+    """Perf-attribution plane cost rows (``benchmarks/perf_overhead_bench.py``):
+    steady-state overhead of ``obs.perf`` instrumentation + ledger (must stay
+    <=2%), plus the plane's own ``perf_mfu`` and ``goodput_fraction`` on the
+    bench workload (direction-pinned in ``bench_compare.py``).  Set
+    ``BENCH_PERF=0`` to skip."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    try:
+        import perf_overhead_bench
+    finally:
+        sys.path.pop(0)
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        perf_overhead_bench.main([])
+    return [json.loads(line) for line in buf.getvalue().splitlines() if line.strip()]
+
+
 def bench_ir_audit() -> dict:
     """Wall-clock of the full ``jaxlint-ir`` audit (``sheeprl_tpu/analysis/ir``):
     AOT-lower + compile + rule-check every entry point's jitted update and both
@@ -476,6 +485,14 @@ def main() -> None:
                 print(json.dumps(row))
         except Exception as exc:
             print(json.dumps({"metric": "checkpoint_save_seconds", "error": str(exc)[:200]}))
+    # Perf-attribution overhead rows (PR-19): instrument+ledger on vs off on a
+    # ~1 ms/step jitted workload, plus the plane's own MFU/goodput figures.
+    if os.environ.get("BENCH_PERF", "1") != "0":
+        try:
+            for row in bench_perf_overhead():
+                print(json.dumps(row))
+        except Exception as exc:
+            print(json.dumps({"metric": "perf_overhead_pct", "error": str(exc)[:200]}))
     # DroQ UTD-20 fused-block row: same auxiliary-row contract.
     if os.environ.get("BENCH_DROQ", "1") != "0":
         try:

@@ -52,10 +52,19 @@ _LOWER_BETTER_METRICS = (
     "checkpoint_save_seconds",
     "fleet_p99_ms",
     "obs_fleet_overhead_pct",
+    "perf_overhead_pct",
     "race_detect_overhead_pct",
     "resume_restore_seconds",
     "serve_p99_ms",
     "serve_startup_seconds",
+)
+# Exact-name higher-better pins (beat the unit-hint heuristic, whose "time"/
+# "wall clock" words would otherwise misread these): the perf-attribution
+# plane's own figures regress when they DROP — a fall in perf_mfu or
+# goodput_fraction means lost utilization or lost useful-work share.
+_HIGHER_BETTER_METRICS = (
+    "goodput_fraction",
+    "perf_mfu",
 )
 
 
@@ -97,6 +106,8 @@ def extract_metrics(path: str) -> Dict[str, Tuple[float, str]]:
 def lower_is_better(metric: str, unit: str) -> bool:
     if str(metric).lower() in _LOWER_BETTER_METRICS:
         return True
+    if str(metric).lower() in _HIGHER_BETTER_METRICS:
+        return False
     if str(metric).lower().startswith(_HIGHER_BETTER_PREFIXES):
         return False
     blob = f"{metric} {unit}".lower()

@@ -8,6 +8,10 @@ seq 64 × 64×64×3 config) on the real chip and prints one JSON line per size, 
 
 Usage: ``python benchmarks/mfu_sweep.py [S M L S:64]`` — ``SIZE:BATCH`` entries
 override the batch size (default 16), probing the arithmetic-intensity lever.
+
+FLOPs and peak figures come from the perf attribution plane
+(``sheeprl_tpu/obs/perf.py``) via ``bench.bench_train_only`` — one MFU
+definition shared with the in-run ``Perf/mfu`` gauge.
 """
 
 import json

@@ -1,0 +1,132 @@
+"""Perf-attribution plane overhead bench (PR-19 acceptance: <=2%).
+
+A/B of the same jitted workload with ``obs.perf`` instrumentation + goodput
+ledger ON vs OFF.  The workload is sized to ~1 ms/step (a 512x512 matmul chain)
+— far smaller than any real training dispatch, so the measured overhead is an
+upper bound on what a real run pays per update:
+
+* ``perf_overhead_pct`` — steady-state per-step overhead of the ``instrument``
+  wrapper (call counting) plus one ``PerfPlane.flush`` per log window, as a
+  percentage of the uninstrumented step time.  Lower is better; the acceptance
+  bar is 2%.
+* ``perf_mfu`` / ``goodput_fraction`` — the plane's own figures on the bench
+  workload, direction-pinned higher-better in ``bench_compare.py`` so a
+  regression in attribution coverage (e.g. cost models silently missing)
+  shows up as a drop.
+
+Runs standalone (``python benchmarks/perf_overhead_bench.py``) or via
+``bench.py`` (``BENCH_PERF=0`` skips).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+STEPS = int(os.environ.get("BENCH_PERF_STEPS", "300"))
+FLUSH_EVERY = 50  # PerfPlane.flush cadence, matching a metric.log_every window
+
+
+def _make_step():
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        for _ in range(8):
+            x = jnp.tanh(x @ x)
+        return x
+
+    return jax.jit(step), jnp.ones((512, 512), jnp.float32)
+
+
+def _run(instrumented: bool) -> dict:
+    import jax
+
+    from sheeprl_tpu.config.core import DotDict
+    from sheeprl_tpu.obs import perf as obs_perf
+
+    obs_perf.reset()
+    cfg = DotDict({"obs": {"perf": {"enabled": instrumented}}})
+    step, x = _make_step()
+    if instrumented:
+        step = obs_perf.instrument(cfg, "bench/perf_overhead", step)
+    plane = obs_perf.PerfPlane(cfg) if instrumented else None
+
+    # Warmup: compile + (instrumented) one-time cost-model registration.
+    out = x
+    for _ in range(5):
+        out = step(out)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    t_window = t0
+    out = x
+    for i in range(STEPS):
+        out = step(out)
+        if plane is not None:
+            plane.observe_step()
+            if (i + 1) % FLUSH_EVERY == 0:
+                jax.block_until_ready(out)
+                now = time.perf_counter()
+                plane.flush({"Time/train_time": now - t_window})
+                t_window = now
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+
+    row = {"seconds_per_step": elapsed / STEPS}
+    if plane is not None:
+        report = plane.report()
+        row["mfu"] = float(report["mfu"])
+        row["goodput"] = float(report["goodput"])
+    return row
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    del argv
+    off = _run(instrumented=False)
+    on = _run(instrumented=True)
+    overhead_pct = (on["seconds_per_step"] / off["seconds_per_step"] - 1.0) * 100.0
+    print(
+        json.dumps(
+            {
+                "metric": "perf_overhead_pct",
+                "value": round(overhead_pct, 3),
+                "unit": (
+                    f"% step-time overhead of obs.perf instrument+ledger "
+                    f"(~{off['seconds_per_step'] * 1e3:.2f} ms/step workload, {STEPS} steps); "
+                    "lower is better, budget 2%"
+                ),
+                "budget_pct": 2.0,
+                "within_budget": bool(overhead_pct <= 2.0),
+                "off_ms_per_step": round(off["seconds_per_step"] * 1e3, 4),
+                "on_ms_per_step": round(on["seconds_per_step"] * 1e3, 4),
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "perf_mfu",
+                "value": round(on.get("mfu", 0.0), 5),
+                "unit": "model FLOPs utilization of the bench workload (perf plane's own gauge)",
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "goodput_fraction",
+                "value": round(on.get("goodput", 0.0), 5),
+                "unit": "compute+env fraction of wall clock (perf plane's goodput ledger)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

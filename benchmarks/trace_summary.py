@@ -7,6 +7,7 @@ Usage:
     python benchmarks/trace_summary.py <log_dir>/blackbox/events.jsonl [--json]
     python benchmarks/trace_summary.py <run_dir>/fleet/timeline.jsonl [--json]
     python benchmarks/trace_summary.py <run_dir>/fleet/trace_fleet.json [--json]
+    python benchmarks/trace_summary.py <log_dir>/perf_report.json [--json]
 
 Per span name: call count, total time, share of the top-level (depth-0) wall clock, and
 p50/p95/p99 latencies.  ``--json`` emits the same table as a JSON object for BENCH
@@ -184,6 +185,76 @@ def format_fleet_table(summary: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _is_perf_report(path: str) -> bool:
+    """Perf-attribution reports (``obs/perf.py`` perf_report.json) are a single
+    JSON object with the plane's headline keys — no traceEvents, no JSONL."""
+    if not path.endswith(".json"):
+        return False
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return isinstance(doc, dict) and "mfu" in doc and "goodput_fractions" in doc
+
+
+def summarize_perf(path: str) -> Dict[str, Any]:
+    """perf_report.json -> headline MFU/goodput figures + a per-cost-model table
+    (FLOPs per call, call count, share of total attributed FLOPs)."""
+    with open(path) as f:
+        doc = json.load(f)
+    models = doc.get("cost_models") or {}
+    total_flops = sum(m.get("flops", 0.0) * m.get("calls", 0) for m in models.values()) or 0.0
+    rows = {}
+    for name, model in sorted(
+        models.items(), key=lambda kv: -(kv[1].get("flops", 0.0) * kv[1].get("calls", 0))
+    ):
+        attributed = model.get("flops", 0.0) * model.get("calls", 0)
+        rows[name] = {
+            "calls": int(model.get("calls", 0)),
+            "flops_per_call": float(model.get("flops", 0.0)),
+            "share": attributed / total_flops if total_flops > 0 else 0.0,
+        }
+    return {
+        "perf_report": path,
+        "role": doc.get("role"),
+        "device_kind": doc.get("device_kind"),
+        "elapsed_s": doc.get("elapsed_s"),
+        "mfu": doc.get("mfu"),
+        "hbm_bw_util": doc.get("hbm_bw_util"),
+        "achieved_flops_per_sec": doc.get("achieved_flops_per_sec"),
+        "goodput": doc.get("goodput"),
+        "goodput_fractions": doc.get("goodput_fractions") or {},
+        "anomalies": doc.get("anomalies", 0),
+        "cost_models": rows,
+    }
+
+
+def format_perf_table(summary: Dict[str, Any]) -> str:
+    lines = [
+        f"perf report {summary['perf_report']}  role={summary.get('role')}  "
+        f"device={summary.get('device_kind') or '?'}",
+        f"mfu={summary.get('mfu', 0.0):.4f}  hbm_bw_util={summary.get('hbm_bw_util', 0.0):.4f}  "
+        f"goodput={summary.get('goodput', 0.0):.4f}  anomalies={summary.get('anomalies', 0)}  "
+        f"elapsed={summary.get('elapsed_s', 0.0):.1f}s",
+        "goodput: "
+        + "  ".join(
+            f"{cat}={frac * 100:.1f}%" for cat, frac in sorted(summary["goodput_fractions"].items())
+        ),
+    ]
+    rows = [
+        (name, str(r["calls"]), f"{r['flops_per_call']:.3g}", f"{r['share'] * 100:.1f}%")
+        for name, r in summary["cost_models"].items()
+    ]
+    headers = ("cost model", "calls", "flops/call", "share")
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h) for i, h in enumerate(headers)]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    lines.append("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
 def _is_blackbox_log(path: str) -> bool:
     if path.endswith(".jsonl"):
         return True
@@ -223,6 +294,8 @@ def summarize_blackbox(path: str) -> Dict[str, Any]:
 
 
 def summarize(path: str) -> Dict[str, Any]:
+    if _is_perf_report(path):
+        return summarize_perf(path)
     if _is_fleet_timeline(path):
         return summarize_fleet(path)
     if _is_blackbox_log(path):
@@ -321,6 +394,8 @@ def main(argv=None) -> int:
     summary = summarize(args.trace)
     if args.json:
         print(json.dumps(summary, indent=2))
+    elif "perf_report" in summary:
+        print(format_perf_table(summary))
     elif "slots" in summary:
         print(format_fleet_table(summary))
     else:

@@ -25,6 +25,7 @@ from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled
 from sheeprl_tpu.utils.env import make_vector_env
@@ -132,7 +133,7 @@ def main(ctx, cfg) -> None:
     gae_fn = jax.jit(lambda r, v, d, nv: gae(r, v, d, nv, rollout_steps, gamma, gae_lambda))
 
     # analysis.strict: signature guard on the jitted update (drift -> hard error)
-    train_fn = strict_guard(cfg, "a2c/train_fn", train_fn)
+    train_fn = obs_perf.instrument(cfg, "a2c/train_fn", strict_guard(cfg, "a2c/train_fn", train_fn))
 
     # Flight recorder: arm the replay builder with everything needed to rebuild
     # this update from the dump alone.

@@ -33,6 +33,7 @@ from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_transition_ring
 from sheeprl_tpu.data.prefetch import maybe_prefetcher
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.utils.blocks import FusedRingDispatcher, WindowedFutures
@@ -313,8 +314,8 @@ def main(ctx, cfg) -> None:
         actor, critic, cfg, act_space
     )
     # analysis.strict: signature guards on the jitted host-path updates
-    train_critics_fn = strict_guard(cfg, "droq/train_critics_fn", train_critics_fn)
-    train_actor_fn = strict_guard(cfg, "droq/train_actor_fn", train_actor_fn)
+    train_critics_fn = obs_perf.instrument(cfg, "droq/train_critics_fn", strict_guard(cfg, "droq/train_critics_fn", train_critics_fn))
+    train_actor_fn = obs_perf.instrument(cfg, "droq/train_actor_fn", strict_guard(cfg, "droq/train_actor_fn", train_actor_fn))
     opt_state = ctx.replicate(
         {
             "actor": actor_opt.init(params["actor"]),
@@ -367,7 +368,12 @@ def main(ctx, cfg) -> None:
     if ring is not None:
         _, _, _, fused_builder = make_droq_fused_builder(actor, critic, cfg, act_space, ring, batch_size)
         fused = FusedRingDispatcher(
-            fused_builder, base_key=ctx.rng(), futures=futures, last_sensitive=True
+            fused_builder,
+            base_key=ctx.rng(),
+            futures=futures,
+            last_sensitive=True,
+            cfg=cfg,
+            perf_name="droq/fused_block",
         )
         # Donation safety: critic_target aliases critic's buffers at init — a
         # donated carry must not contain the same buffer twice.

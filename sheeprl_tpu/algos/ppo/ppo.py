@@ -46,6 +46,7 @@ from sheeprl_tpu.checkpoint.manager import CheckpointManager
 from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled
 from sheeprl_tpu.precision import train_policy
@@ -294,7 +295,7 @@ def main(ctx, cfg) -> None:
 
     act_fn, values_fn, train_fn, gae_fn = fns.act_fn, fns.values_fn, fns.train_fn, fns.gae_fn
     # analysis.strict: signature guard on the jitted update (drift -> hard error)
-    train_fn = strict_guard(cfg, "ppo/train_fn", train_fn)
+    train_fn = obs_perf.instrument(cfg, "ppo/train_fn", strict_guard(cfg, "ppo/train_fn", train_fn))
     gamma = cfg.algo.gamma
 
     # Flight recorder (obs/flight_recorder.py): the replay builder rebuilds this

@@ -48,6 +48,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.distributed.placement import placement_from_cfg
 from sheeprl_tpu.distributed.publish import evict_and_put, make_stamp, staleness_steps
 from sheeprl_tpu.distributed.transport import maybe_digest
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -101,7 +102,7 @@ def main(ctx, cfg) -> None:
     grad_steps_per_update = fns.grad_steps_per_update
     opt_state = ctx.replicate(fns.opt.init(params))
     act_fn, values_fn, train_fn, gae_fn = fns.act_fn, fns.values_fn, fns.train_fn, fns.gae_fn
-    train_fn = strict_guard(cfg, "ppo_decoupled/train_fn", train_fn)
+    train_fn = obs_perf.instrument(cfg, "ppo_decoupled/train_fn", strict_guard(cfg, "ppo_decoupled/train_fn", train_fn))
     gamma = cfg.algo.gamma
 
     # Flight recorder: the coupled entry point's replay builder rebuilds this same

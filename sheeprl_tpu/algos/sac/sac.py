@@ -30,6 +30,7 @@ from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_transition_ring
 from sheeprl_tpu.data.prefetch import maybe_prefetcher
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.precision import train_policy
@@ -247,7 +248,7 @@ def main(ctx, cfg) -> None:
 
     actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
     actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
-    train_fn = strict_guard(cfg, "sac/train_fn", train_fn)
+    train_fn = obs_perf.instrument(cfg, "sac/train_fn", strict_guard(cfg, "sac/train_fn", train_fn))
     recorder = flight_recorder.get_active()
     if recorder is not None:
         recorder.arm_replay(
@@ -306,7 +307,9 @@ def main(ctx, cfg) -> None:
     fused = None
     if ring is not None:
         _, _, _, fused_builder = make_sac_fused_builder(actor, critic, cfg, act_space, ring, batch_size)
-        fused = FusedRingDispatcher(fused_builder, base_key=ctx.rng(), futures=futures)
+        fused = FusedRingDispatcher(
+            fused_builder, base_key=ctx.rng(), futures=futures, cfg=cfg, perf_name="sac/fused_block"
+        )
         # Donation safety: critic_target aliases critic's buffers at init (the
         # identity tree.map in build_agent) — a donated carry must not contain the
         # same buffer twice, so deep-copy the train state once up front.

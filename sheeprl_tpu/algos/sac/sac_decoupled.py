@@ -39,6 +39,7 @@ from sheeprl_tpu.data.device_buffer import make_transition_ring
 from sheeprl_tpu.distributed.placement import placement_from_cfg
 from sheeprl_tpu.distributed.publish import evict_and_put, make_stamp, staleness_steps
 from sheeprl_tpu.distributed.transport import maybe_digest
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs import TrainingMonitor
 from sheeprl_tpu.utils.blocks import FusedRingDispatcher
 from sheeprl_tpu.utils.env import make_vector_env
@@ -80,7 +81,7 @@ def main(ctx, cfg) -> None:
 
     actor, critic, params = build_agent(ctx, act_space, obs_space, cfg)
     actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor, critic, cfg, act_space)
-    train_fn = strict_guard(cfg, "sac_decoupled/train_fn", train_fn)
+    train_fn = obs_perf.instrument(cfg, "sac_decoupled/train_fn", strict_guard(cfg, "sac_decoupled/train_fn", train_fn))
     # Flight recorder: decoupled dumps replay through the coupled builder (same
     # make_sac_train_fn update).
     from sheeprl_tpu.obs import flight_recorder
@@ -144,7 +145,9 @@ def main(ctx, cfg) -> None:
     fused = None
     if ring is not None:
         _, _, _, fused_builder = make_sac_fused_builder(actor, critic, cfg, act_space, ring, batch_size)
-        fused = FusedRingDispatcher(fused_builder, base_key=ctx.rng())
+        fused = FusedRingDispatcher(
+            fused_builder, base_key=ctx.rng(), cfg=cfg, perf_name="sac_decoupled/fused_block"
+        )
         # Donation safety: critic_target aliases critic's buffers at init — a
         # donated carry must not contain the same buffer twice.
         params = jax.tree.map(jnp.copy, params)

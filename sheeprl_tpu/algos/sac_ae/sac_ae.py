@@ -30,6 +30,7 @@ from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import make_transition_ring
 from sheeprl_tpu.data.prefetch import maybe_prefetcher
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
 from sheeprl_tpu.obs.health import diagnostics, health_enabled, replay_age_metrics
 from sheeprl_tpu.rollout import rollout_metrics
@@ -300,7 +301,7 @@ def main(ctx, cfg) -> None:
     # analysis.strict: signature guard on the jitted update (drift -> hard error).
     # The fused ring block below inlines the RAW update (its outer jit carries the
     # guard semantics via the dispatcher's fixed signature).
-    train_fn = strict_guard(cfg, "sac_ae/train_fn", raw_train_fn)
+    train_fn = obs_perf.instrument(cfg, "sac_ae/train_fn", strict_guard(cfg, "sac_ae/train_fn", raw_train_fn))
 
     futures = WindowedFutures()
     fused = None
@@ -335,7 +336,9 @@ def main(ctx, cfg) -> None:
 
             return block
 
-        fused = FusedRingDispatcher(fused_builder, base_key=ctx.rng(), futures=futures)
+        fused = FusedRingDispatcher(
+            fused_builder, base_key=ctx.rng(), futures=futures, cfg=cfg, perf_name="sac_ae/fused_block"
+        )
         # Donation safety: the target networks alias their online buffers at init
         # (identity tree.map in build_agent) — a donated carry must not contain
         # the same buffer twice.

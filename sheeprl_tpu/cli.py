@@ -249,6 +249,11 @@ def run_algorithm(cfg: DotDict) -> None:
             race_runtime.uninstall()
         flight_recorder.install(None)
         obs_fleet.close_active()
+        # Cost-model registry is process-global: clear it between multirun jobs
+        # so one job's lowered FLOPs never leak into the next job's MFU.
+        from sheeprl_tpu.obs import perf as obs_perf
+
+        obs_perf.reset()
 
 
 def eval_algorithm(cfg: DotDict) -> None:

@@ -716,6 +716,14 @@ def sample_index_block(rb, batch_size: int, sequence_length: int, n: int, dp: in
     return envs, starts
 
 
+def _algo_name(cfg) -> str:
+    """Best-effort ``cfg.algo.name`` for perf cost-model registration keys."""
+    try:
+        return str(cfg.algo.name)
+    except Exception:
+        return "train"
+
+
 def make_device_replay(
     ctx,
     cfg,
@@ -754,6 +762,7 @@ def make_device_replay(
 
     from sheeprl_tpu.data.prefetch import make_replay_prefetcher
     from sheeprl_tpu.obs import flight_recorder
+    from sheeprl_tpu.obs import perf as obs_perf
     from sheeprl_tpu.utils.blocks import BlockDispatcher, IndexedBlockDispatcher
 
     kwargs = dict(dispatcher_kwargs or {})
@@ -797,6 +806,7 @@ def make_device_replay(
             globalize=mirror.globalize_indices if multiprocess else None,
             **kwargs,
         )
+        dispatcher._block = obs_perf.instrument(cfg, f"{_algo_name(cfg)}/train_block", dispatcher._block)
         prefetcher, rb_lock = None, contextlib.nullcontext()
         dp = mirror.local_dp if multiprocess else mirror.dp
 
@@ -823,6 +833,7 @@ def make_device_replay(
     else:
         mirror = None
         dispatcher = BlockDispatcher(step_fn, **kwargs)
+        dispatcher._block = obs_perf.instrument(cfg, f"{_algo_name(cfg)}/train_block", dispatcher._block)
         prefetcher, rb_lock, sample_block = make_replay_prefetcher(rb, ctx, cfg, batch_size, seq_len)
 
         def run_block(carry, n: int, start_count: int, stage_next: bool = True):

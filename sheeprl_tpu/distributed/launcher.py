@@ -130,6 +130,7 @@ def launch(args: Optional[List[str]] = None) -> int:
                 host=spec.host,
                 liveness_timeout_s=float(fleet_cfg.get("liveness_timeout_s", 10.0)),
                 trace_id=trace_id,
+                max_timeline_mb=float(fleet_cfg.get("max_timeline_mb", 64.0)),
             )
             _log(f"fleet telemetry at {fleet.address} -> {fleet_dir} (trace_id={trace_id})")
         except OSError as e:

@@ -55,6 +55,7 @@ from sheeprl_tpu.distributed.transport import (
     connect,
     maybe_digest,
 )
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs import flight_recorder as _flight_recorder
 from sheeprl_tpu.obs import tracer as _tracer
 from sheeprl_tpu.obs.fleet import maybe_exporter
@@ -541,7 +542,7 @@ def _run_sac_learner(ctx, cfg, spec: PlacementSpec) -> None:
     obs_space, act_space = _probe_spaces(cfg)
     actor_net, critic, params = build_agent(ctx, act_space, obs_space, cfg)
     actor_opt, critic_opt, alpha_opt, train_fn = make_sac_train_fn(actor_net, critic, cfg, act_space)
-    train_fn = strict_guard(cfg, "sac_sebulba/train_fn", train_fn)
+    train_fn = obs_perf.instrument(cfg, "sac_sebulba/train_fn", strict_guard(cfg, "sac_sebulba/train_fn", train_fn))
     opt_state = ctx.replicate(
         {
             "actor": actor_opt.init(params["actor"]),
@@ -806,7 +807,7 @@ def _run_ppo_learner(ctx, cfg, spec: PlacementSpec) -> None:
 
     fns = PPOTrainFns(ctx, agent, cfg, obs_keys, num_updates)
     opt_state = ctx.replicate(fns.opt.init(params))
-    train_fn = strict_guard(cfg, "ppo_sebulba/train_fn", fns.train_fn)
+    train_fn = obs_perf.instrument(cfg, "ppo_sebulba/train_fn", strict_guard(cfg, "ppo_sebulba/train_fn", fns.train_fn))
     if cfg.checkpoint.get("resume_from"):
         state = CheckpointManager.load(
             cfg.checkpoint.resume_from,

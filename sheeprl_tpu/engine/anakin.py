@@ -51,6 +51,7 @@ from sheeprl_tpu.config.core import save_config
 from sheeprl_tpu.envs.jax import make_jax_env
 from sheeprl_tpu.fault.guard import TrainingGuard
 from sheeprl_tpu.obs import TrainingMonitor, flight_recorder
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs.health import health_enabled
 from sheeprl_tpu.precision import train_policy
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -387,13 +388,21 @@ def ppo_anakin(ctx, cfg) -> None:
     # The whole iteration is ONE donated jit: env scan + GAE + the update block —
     # for a population, lifted over the member axis first (howto/population.md).
     if pop.enabled:
-        dispatch = strict_guard(
+        dispatch = obs_perf.instrument(
             cfg,
             "anakin/ppo_pop_dispatch",
-            jax.jit(population_transform(iteration, pop.vectorize, n_args=2), donate_argnums=(0,)),
+            strict_guard(
+                cfg,
+                "anakin/ppo_pop_dispatch",
+                jax.jit(population_transform(iteration, pop.vectorize, n_args=2), donate_argnums=(0,)),
+            ),
         )
     else:
-        dispatch = strict_guard(cfg, "anakin/ppo_dispatch", jax.jit(iteration, donate_argnums=(0,)))
+        dispatch = obs_perf.instrument(
+            cfg,
+            "anakin/ppo_dispatch",
+            strict_guard(cfg, "anakin/ppo_dispatch", jax.jit(iteration, donate_argnums=(0,))),
+        )
 
     if pop.enabled:
         # Per-member init: member 0 draws exactly what the plain path draws
@@ -682,7 +691,9 @@ class SacAnakinDispatcher:
             if self._transform is not None:
                 fn = self._transform(fn)
                 name = f"anakin/sac_pop_dispatch_{steps}x{grad_per_step}{'t' if train else 'p'}"
-            prog = strict_guard(self._cfg, name, jax.jit(fn, donate_argnums=(0,)))
+            prog = obs_perf.instrument(
+                self._cfg, name, strict_guard(self._cfg, name, jax.jit(fn, donate_argnums=(0,)))
+            )
             self._programs[sig] = prog
         return prog(carry)
 

@@ -184,6 +184,7 @@ def supervise_serve(overrides: List[str]) -> int:
                 str(fleet_cfg["dir"]),
                 liveness_timeout_s=float(fleet_cfg.get("liveness_timeout_s", 10.0)),
                 trace_id=trace_id,
+                max_timeline_mb=float(fleet_cfg.get("max_timeline_mb", 64.0)),
             )
             _log(f"fleet telemetry at {fleet.address} -> {fleet_cfg['dir']}")
         except OSError as e:

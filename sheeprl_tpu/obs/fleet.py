@@ -158,6 +158,7 @@ class FleetAggregator:
         port: int = 0,
         liveness_timeout_s: float = 10.0,
         trace_id: Optional[str] = None,
+        max_timeline_mb: float = 64.0,
     ):
         self.fleet_dir = str(fleet_dir)
         os.makedirs(self.fleet_dir, exist_ok=True)
@@ -175,7 +176,19 @@ class FleetAggregator:
         self._dump_done = threading.Condition(self._lock)
         self._bundles = 0
         self._closed = False
-        self._timeline = open(os.path.join(self.fleet_dir, "timeline.jsonl"), "a")
+        # Per-slot {generation: [first_wall_clock, last_wall_clock]} — the gaps
+        # between consecutive generations are restart/drain downtime in the
+        # goodput.json rollup written at close.
+        self._gen_spans: Dict[str, Dict[int, List[float]]] = {}
+        # Size-capped timeline: the merged JSONL rotates once past the cap
+        # (timeline.jsonl -> timeline.jsonl.1), bounding disk at ~2x the cap
+        # while obs.top's tail rebuild reads across the boundary.
+        self.max_timeline_bytes = max(int(float(max_timeline_mb) * 1024 * 1024), 1)
+        self._timeline = open(self.timeline_path, "a")
+        try:
+            self._timeline_bytes = os.path.getsize(self.timeline_path)
+        except OSError:
+            self._timeline_bytes = 0
         self._listener = Listener(host, port)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="fleet-accept", daemon=True
@@ -191,8 +204,16 @@ class FleetAggregator:
         return os.path.join(self.fleet_dir, "timeline.jsonl")
 
     @property
+    def rotated_timeline_path(self) -> str:
+        return os.path.join(self.fleet_dir, "timeline.jsonl.1")
+
+    @property
     def snapshot_path(self) -> str:
         return os.path.join(self.fleet_dir, "snapshot.json")
+
+    @property
+    def goodput_path(self) -> str:
+        return os.path.join(self.fleet_dir, "goodput.json")
 
     # ------------------------------------------------------------------ intake
     def _accept_loop(self) -> None:
@@ -288,13 +309,34 @@ class FleetAggregator:
             proc["wall_clock"] = wall_clock
             proc["alive"] = True
             proc["metrics"] = metrics
+            gen = int(meta.get("generation", 0) or 0)
+            span = self._gen_spans.setdefault(key, {}).get(gen)
+            if span is None:
+                self._gen_spans[key][gen] = [wall_clock, wall_clock]
+            else:
+                span[1] = wall_clock
             row = {k: meta.get(k) for k in ROW_TAG_KEYS}
             row["metrics"] = metrics
-            self._timeline.write(json.dumps(row) + "\n")
+            line = json.dumps(row) + "\n"
+            self._timeline.write(line)
             self._timeline.flush()
             self.rows_written += 1
+            self._timeline_bytes += len(line)
+            if self._timeline_bytes >= self.max_timeline_bytes:
+                self._rotate_timeline_locked()
+                self._timeline_bytes = 0
         self._write_snapshot()
         return key
+
+    def _rotate_timeline_locked(self) -> None:
+        """Roll ``timeline.jsonl`` to ``timeline.jsonl.1`` (one rotated
+        generation — disk stays bounded at ~2x the cap).  Caller holds _lock."""
+        try:
+            self._timeline.close()
+            os.replace(self.timeline_path, self.rotated_timeline_path)
+        except OSError as e:  # pragma: no cover - disk trouble must not kill intake
+            warnings.warn(f"fleet: could not rotate timeline: {e}")
+        self._timeline = open(self.timeline_path, "a")
 
     # --------------------------------------------------------------- snapshot
     def note_respawn(self, actor_id: int, count: int) -> None:
@@ -411,11 +453,69 @@ class FleetAggregator:
         _atomic_write_json(os.path.join(bundle, "manifest.json"), manifest)
         return bundle
 
+    # ---------------------------------------------------------------- goodput
+    def goodput_report(self) -> Dict[str, Any]:
+        """Fleet goodput rollup: per-slot attribution + the fleet's ceiling.
+
+        Each slot carries its last ``Perf/goodput``/``Perf/mfu`` gauges (pushed
+        by the per-process :class:`~sheeprl_tpu.obs.perf.PerfPlane`), restart
+        downtime derived from the gaps between its generations' timeline spans,
+        and the ``perf_anomalies`` count.  The fleet section names the slot with
+        the lowest goodput — the straggler capping the whole run."""
+        with self._lock:
+            procs = {
+                key: {
+                    "tags": dict(proc.get("tags") or {}),
+                    "metrics": dict(proc.get("metrics") or {}),
+                }
+                for key, proc in self._procs.items()
+            }
+            spans = {key: {g: list(v) for g, v in s.items()} for key, s in self._gen_spans.items()}
+        slots: Dict[str, Any] = {}
+        values: List[Tuple[str, float]] = []
+        for key in sorted(set(procs) | set(spans)):
+            proc = procs.get(key) or {"tags": {}, "metrics": {}}
+            metrics = proc["metrics"]
+            goodput = metrics.get("Perf/goodput")
+            slot_spans = spans.get(key) or {}
+            gens = sorted(slot_spans)
+            downtime = sum(
+                max(0.0, slot_spans[b][0] - slot_spans[a][1]) for a, b in zip(gens, gens[1:])
+            )
+            slots[key] = {
+                "role": proc["tags"].get("role"),
+                "actor_id": proc["tags"].get("actor_id"),
+                "generation": proc["tags"].get("generation"),
+                "generations": len(gens) or 1,
+                "goodput": goodput,
+                "mfu": metrics.get("Perf/mfu"),
+                "anomalies": float(metrics.get("perf_anomalies", 0.0) or 0.0),
+                "restart_downtime_s": downtime,
+            }
+            if goodput is not None:
+                values.append((key, float(goodput)))
+        fleet = {
+            "min_goodput": min(v for _, v in values) if values else None,
+            "mean_goodput": sum(v for _, v in values) / len(values) if values else None,
+            "ceiling_slot": min(values, key=lambda kv: kv[1])[0] if values else None,
+            "anomalies": sum(float(s["anomalies"]) for s in slots.values()),
+        }
+        return {
+            "trace_id": self.trace_id,
+            "written": time.time(),
+            "slots": slots,
+            "fleet": fleet,
+        }
+
     # ------------------------------------------------------------------ close
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        try:
+            _atomic_write_json(self.goodput_path, self.goodput_report())
+        except OSError as e:  # pragma: no cover
+            warnings.warn(f"fleet: could not write goodput rollup: {e}")
         # Merged Perfetto timeline from every trace stream shipped at exporter
         # close: one file, one track per real pid.
         with self._lock:
@@ -667,6 +767,7 @@ def maybe_exporter(
             own = FleetAggregator(
                 str(fleet_cfg["dir"]),
                 liveness_timeout_s=float(fleet_cfg.get("liveness_timeout_s", 10.0)),
+                max_timeline_mb=float(fleet_cfg.get("max_timeline_mb", 64.0)),
             )
             if not tags["trace_id"]:
                 tags["trace_id"] = own.trace_id

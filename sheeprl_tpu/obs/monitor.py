@@ -66,6 +66,21 @@ class TrainingMonitor:
                 cfg=cfg,
             )
             _flight_recorder.install(self.recorder)
+        # The performance-attribution plane (obs/perf.py) is likewise independent
+        # of obs.enabled: MFU/goodput gauges and perf_report.json must exist on
+        # runs that never turned the tracer on.
+        from sheeprl_tpu.obs.perf import PerfPlane
+
+        self.perf = PerfPlane(cfg)
+        # Capture machinery lives in the common path (not behind obs.enabled) so
+        # the perf watchdog's anomaly auto-capture can open an XProf window on an
+        # otherwise-untraced run.
+        self._capture = None
+        self._capturing = False
+        self._session = None
+        self._annotation = None
+        self._host_tracer_level = int(obs_cfg.get("host_tracer_level", 0))
+        self._perf_capture_remaining = 0
         if not self.enabled:
             return
 
@@ -79,16 +94,13 @@ class TrainingMonitor:
         # global tracer, registering the jax.monitoring listener) so a bad config
         # cannot leak process-global state.
         capture = obs_cfg.get("capture_steps")
-        self._capture = None
         if capture:
             start, end = int(capture[0]), int(capture[1])
             if start < 1 or end < start:
                 raise ValueError(f"obs.capture_steps must be [start>=1, end>=start]; got {capture!r}")
             self._capture = (start, end)
-        self._capturing = False
 
         self._xprof = bool(obs_cfg.get("xprof_annotations", True))
-        self._annotation = None
         self._warmup_updates = max(int(obs_cfg.get("warmup_updates", 1)), 0)
         self._telemetry_latest: Dict[str, float] = {}
 
@@ -104,20 +116,31 @@ class TrainingMonitor:
         if bool(obs_cfg.get("watchdog", True)):
             self._watchdog = RecompileWatchdog()
 
-        self._host_tracer_level = int(obs_cfg.get("host_tracer_level", 0))
-        self._session = None
-
     # ------------------------------------------------------------------ per update
     def advance(self, policy_step: Optional[int] = None) -> None:
         """Call once at the top of every training update."""
+        self._updates += 1
+        # Perf regression watchdog runs in the common path: a sustained step-time
+        # degradation fires one perf_regression event + one bounded auto-capture
+        # even when the tracer stack is off.
+        event = self.perf.observe_step()
+        if event is not None:
+            _flight_recorder.record_event(
+                "perf_regression",
+                update=self._updates - 1,
+                baseline_s=event["baseline_s"],
+                ewma_s=event["ewma_s"],
+                degradation=event["degradation"],
+                capture=bool(event.get("capture")),
+            )
         if not self.enabled:
+            self._perf_capture_tick(event)
             return
         if self.strict:
             # update boundary: surface any NaN/Inf the in-jit nan_scan callbacks saw
             from sheeprl_tpu.analysis.strict import raise_pending
 
             raise_pending()
-        self._updates += 1
         update = self._updates
 
         if self.tracer is not None:
@@ -134,6 +157,7 @@ class TrainingMonitor:
             self._annotation.__exit__(None, None, None)
             self._annotation = None
 
+        self._perf_capture_tick(event)
         if self._capture is not None:
             start, end = self._capture
             if update == start and not self._capturing:
@@ -174,6 +198,16 @@ class TrainingMonitor:
             polled = self._telemetry.poll()
             if polled:
                 self._telemetry_latest = polled
+
+    def _perf_capture_tick(self, event: Optional[Dict[str, float]]) -> None:
+        """Drive the watchdog's bounded auto-capture window (obs.perf.capture_updates)."""
+        if event is not None and event.get("capture") and not self._capturing:
+            self._perf_capture_remaining = max(1, self.perf.capture_updates)
+            self._start_capture()
+        elif self._perf_capture_remaining > 0:
+            self._perf_capture_remaining -= 1
+            if self._perf_capture_remaining <= 0 and self._capturing:
+                self._stop_capture()
 
     # ------------------------------------------------------------------ metrics/logging
     def span(self, name: str):
@@ -223,11 +257,15 @@ class TrainingMonitor:
 
         metrics.update(_timer.to_dict(reset=True))
         metrics.update(fault_metrics())
+        # Perf gauges fold in AFTER the timer drain (the goodput ledger reads the
+        # Time/* keys straight out of the flush) and run regardless of obs.enabled.
+        recompile_s = self._watchdog.drain_compile_seconds() if self._watchdog is not None else 0.0
+        self.perf.flush(metrics, recompile_s=recompile_s)
         if _flight_recorder.get_active() is not None:
             snapshot = {
                 k: metrics[k]
                 for k in metrics
-                if k.startswith(("Health/", "Loss/", "Compile/", "Rollout/"))
+                if k.startswith(("Health/", "Loss/", "Compile/", "Rollout/", "Perf/"))
             }
             _flight_recorder.record_event(
                 "metric_flush", step=step, n_metrics=len(metrics), values=snapshot
@@ -306,6 +344,14 @@ class TrainingMonitor:
                 except OSError as e:
                     warnings.warn(f"could not export Chrome trace: {e}")
                 _tracer.set_active(self._prev_tracer)
+        elif self._capturing:
+            # an anomaly auto-capture may be open on an otherwise-untraced run
+            self._stop_capture()
+        from sheeprl_tpu.obs.perf import report_path
+
+        path = report_path(self.log_dir)
+        if path:
+            self.perf.write_report(path)
         # Strict runs drain outstanding in-jit nan_scan callbacks one last time
         # AFTER teardown: a NaN in the final update (no later advance() to surface
         # it) must still crash the run — and therefore trigger the blackbox dump —

@@ -43,6 +43,8 @@ _COLUMNS = (
     ("RESPAWN", 8),
     ("SLO%", 6),
     ("P99MS", 8),
+    ("MFU%", 6),
+    ("GOODPUT", 8),
 )
 
 
@@ -55,11 +57,18 @@ def load_snapshot(fleet_dir: str) -> Optional[Dict[str, Any]]:
             return json.load(f)
     except (OSError, ValueError):
         pass
-    timeline = os.path.join(fleet_dir, "timeline.jsonl")
-    try:
-        with open(timeline) as f:
-            lines = f.readlines()
-    except OSError:
+    # Rotation-aware tail rebuild: the aggregator size-caps the timeline by
+    # renaming it to ``timeline.jsonl.1`` and starting fresh, so read the
+    # rotated generation first — rows in the live file are strictly newer and
+    # overwrite the same slot keys.
+    lines: List[str] = []
+    for name in ("timeline.jsonl.1", "timeline.jsonl"):
+        try:
+            with open(os.path.join(fleet_dir, name)) as f:
+                lines.extend(f.readlines())
+        except OSError:
+            continue
+    if not lines:
         return None
     procs: Dict[str, Any] = {}
     for line in lines:
@@ -121,6 +130,7 @@ def format_top(snapshot: Dict[str, Any], now: Optional[float] = None) -> str:
         wall = proc.get("wall_clock")
         age_s = (now - wall) if isinstance(wall, (int, float)) else None
         slo_burn = _first(metrics, "Serve/slo_burn")
+        mfu = _first(metrics, "Perf/mfu")
         cells = [
             key.ljust(_COLUMNS[0][1]),
             str(proc.get("role", "?")).ljust(_COLUMNS[1][1]),
@@ -142,6 +152,8 @@ def format_top(snapshot: Dict[str, Any], now: Optional[float] = None) -> str:
                 _first(metrics, "Serve/latency_p99_ms", "Fleet/latency_p99_ms"),
                 _COLUMNS[12][1],
             ),
+            _fmt(None if mfu is None else mfu * 100.0, _COLUMNS[13][1]),
+            _fmt(_first(metrics, "Perf/goodput"), _COLUMNS[14][1], 2),
         ]
         lines.append(" ".join(cells))
     if not procs:

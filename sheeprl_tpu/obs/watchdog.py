@@ -31,6 +31,8 @@ class RecompileWatchdog:
         self._total = 0
         self._post_warmup = 0
         self._unseen = 0  # post-warmup compiles not yet drained by poll_new()
+        self._compile_seconds = 0.0  # cumulative backend-compile wall clock
+        self._unseen_seconds = 0.0  # compile seconds not yet drained (goodput ledger)
         self._warm = False
         self._active = True
 
@@ -39,6 +41,8 @@ class RecompileWatchdog:
                 return
             with self._lock:
                 self._total += 1
+                self._compile_seconds += float(duration_secs or 0.0)
+                self._unseen_seconds += float(duration_secs or 0.0)
                 if self._warm:
                     self._post_warmup += 1
                     self._unseen += 1
@@ -68,10 +72,22 @@ class RecompileWatchdog:
             self._unseen = 0
         return n
 
+    @property
+    def compile_seconds(self) -> float:
+        return self._compile_seconds
+
+    def drain_compile_seconds(self) -> float:
+        """Backend-compile seconds since the last drain (goodput ledger input)."""
+        with self._lock:
+            s = self._unseen_seconds
+            self._unseen_seconds = 0.0
+        return s
+
     def metrics(self) -> Dict[str, float]:
         return {
             "Compile/total_compiles": float(self._total),
             "Compile/recompiles": float(self._post_warmup),
+            "Compile/compile_seconds": float(self._compile_seconds),
         }
 
     def close(self) -> None:

@@ -365,6 +365,7 @@ class FleetManager:
                     str(obs_fleet["dir"]),
                     liveness_timeout_s=float(obs_fleet.get("liveness_timeout_s", 10.0)),
                     trace_id=self.trace_id,
+                    max_timeline_mb=float(obs_fleet.get("max_timeline_mb", 64.0)),
                 )
                 self._log(f"fleet telemetry at {self.fleet.address} -> {obs_fleet['dir']}")
             except OSError as e:

@@ -43,13 +43,17 @@ def dispatch_key(seed: int, counter: int) -> np.ndarray:
     return np.array([seed & 0xFFFFFFFF, counter & 0xFFFFFFFF], np.dtype(KEY_DTYPE))
 
 
-def precompile_ladder(policy, ladder: Sequence[int]) -> Tuple[Dict[int, Any], float]:
+def precompile_ladder(policy, ladder: Sequence[int], perf_name: str = None) -> Tuple[Dict[int, Any], float]:
     """AOT-compile ``policy.act_fn`` at every ladder bucket.
 
     Returns ``(bucket -> jax Compiled executable, seconds spent)``.  Each
     executable is also run once on zeros: the first real request must never pay
     first-call costs, and a ladder entry that compiles but cannot execute should
     fail at startup, not mid-traffic.
+
+    ``perf_name`` registers every bucket's XLA cost model with the perf
+    attribution plane (``obs/perf.py``) under ``<perf_name>/b<bucket>`` — the
+    server turns dispatch counts into per-bucket MFU in its exit summary.
     """
     import jax
 
@@ -69,6 +73,10 @@ def precompile_ladder(policy, ladder: Sequence[int]) -> Tuple[Dict[int, Any], fl
             exe = jitted.lower(policy.params, obs, key).compile()
             jax.block_until_ready(exe(policy.params, obs, key))
         compiled[int(bucket)] = exe
+        if perf_name:
+            from sheeprl_tpu.obs import perf as obs_perf
+
+            obs_perf.register_compiled(f"{perf_name}/b{int(bucket)}", exe)
     return compiled, time.perf_counter() - t0
 
 

@@ -59,6 +59,7 @@ import numpy as np
 
 from sheeprl_tpu.distributed.transport import Channel, ChannelClosed, Listener
 from sheeprl_tpu.fault import preemption as fault_preemption
+from sheeprl_tpu.obs import perf as obs_perf
 from sheeprl_tpu.obs.fleet import maybe_exporter
 from sheeprl_tpu.serve.batching import bucket_ladder, collect_batch, pad_obs_batch, pick_bucket
 from sheeprl_tpu.serve.precompile import dispatch_key, precompile_ladder, zero_key
@@ -94,6 +95,11 @@ class _Endpoint:
         self.seed = seed
         self.state_cache = None  # SessionStateCache for stateful policies
         self.dispatch_counter = 0
+        # Per-bucket dispatch count + infer seconds — with the registered XLA
+        # cost models (obs/perf.py) this yields per-bucket MFU in the exit
+        # summary.  Single writer (the dispatcher thread); readers tolerate a
+        # torn [count, seconds] pair (one 1 Hz gauge sample, self-correcting).
+        self.bucket_stats: Dict[int, List[float]] = {}
         # accepted is bumped by one reader thread per client connection; an
         # unguarded += is a read-modify-write that loses updates (JL008), which
         # would silently break the accepted == replied + dropped summary
@@ -151,6 +157,7 @@ class PolicyServer:
         self._fleet = None  # FleetExporter, attached in run()
 
         t0 = time.perf_counter()
+        self._perf_t0 = t0  # perf attribution clock: startup compiles count too
         self._load_policies()
         self.startup_seconds = time.perf_counter() - t0
 
@@ -209,7 +216,11 @@ class PolicyServer:
                 )
                 self.parity[canonical] = parity_stamp(policy, reference, seed=seed)
                 print(f"[serve] {canonical}: parity {self.parity[canonical]}", flush=True)
-            compiled, secs = precompile_ladder(policy, ladder)
+            compiled, secs = precompile_ladder(
+                policy,
+                ladder,
+                perf_name=f"serve/{canonical}" if obs_perf.perf_enabled(self.cfg) else None,
+            )
             self.precompile_seconds += secs
             ep = _Endpoint(
                 name=name,
@@ -324,6 +335,7 @@ class PolicyServer:
                 except Exception:
                     pass
             self._write_summary(preempted=preempted)
+            self._write_perf_report()
             self._close()
         return fault_preemption.RESUMABLE_EXIT_CODE if preempted else 0
 
@@ -470,6 +482,10 @@ class PolicyServer:
                 raise RecompileError(msg)
             warnings.warn(msg, RecompileWarning)
 
+        stats = ep.bucket_stats.setdefault(bucket, [0, 0.0])
+        stats[0] += 1
+        stats[1] += t1 - t0
+
         infer_ms = (t1 - t0) * 1000.0
         ep.metrics.update("Serve/infer_ms", infer_ms)
         ep.metrics.update("Serve/batch_fill", n / bucket)
@@ -549,6 +565,10 @@ class PolicyServer:
                     p99 = p
         if p99 == p99:
             exporter.gauge("Serve/latency_p99_ms", p99)
+        if obs_perf.perf_enabled(self.cfg):
+            perf = self.perf_summary()
+            exporter.gauge("Perf/mfu", perf["mfu"])
+            exporter.gauge("Perf/goodput", perf["goodput"])
 
     def _log_status(self) -> None:
         for ep in self.endpoints.values():
@@ -608,6 +628,63 @@ class PolicyServer:
             "precision": self.precision,
             "parity": self.parity,
             "policies": per_policy,
+            "perf": self.perf_summary() if obs_perf.perf_enabled(self.cfg) else None,
+        }
+
+    def perf_summary(self) -> Dict[str, Any]:
+        """Cost-model MFU + goodput for this replica (``obs/perf.py`` plane).
+
+        MFU is over the whole process lifetime (startup included), so an idle
+        replica honestly reads near zero; per-bucket MFU uses each bucket's own
+        infer seconds, so it reads the hardware efficiency of the compiled
+        program itself.  Goodput classifies infer time as compute and the
+        ladder's AOT compiles as recompile; the rest (queue waits, idle accept
+        loop) is other.
+        """
+        import jax
+
+        device = jax.devices()[0]
+        peak = obs_perf.peak_flops(device)
+        models = obs_perf.registered_cost_models()
+        per_policy: Dict[str, Any] = {}
+        total_flops = total_bytes = total_infer_s = 0.0
+        for canonical, ep in self.endpoints.items():
+            buckets: Dict[str, Any] = {}
+            for bucket, (count, seconds) in sorted(ep.bucket_stats.items()):
+                model = models.get(f"serve/{canonical}/b{bucket}", {})
+                flops_per_dispatch = float(model.get("flops", 0.0))
+                flops = flops_per_dispatch * count
+                total_flops += flops
+                total_bytes += float(model.get("bytes_accessed", 0.0)) * count
+                total_infer_s += seconds
+                buckets[str(bucket)] = {
+                    "dispatches": int(count),
+                    "infer_s": seconds,
+                    "flops_per_dispatch": flops_per_dispatch,
+                    "mfu": flops / seconds / peak if seconds > 0 and peak > 0 else 0.0,
+                }
+            per_policy[canonical] = buckets
+        elapsed = max(time.perf_counter() - self._perf_t0, 1e-9)
+        ledger = obs_perf.GoodputLedger()
+        fractions = ledger.classify(
+            {"Time/phase_dispatch": total_infer_s},
+            elapsed,
+            recompile_s=self.watchdog.compile_seconds if self.watchdog is not None else 0.0,
+        )
+        return {
+            "role": "serve",
+            "device_kind": str(getattr(device, "device_kind", "") or ""),
+            "peak_flops": peak,
+            "elapsed_s": elapsed,
+            "total_flops": total_flops,
+            "total_bytes_accessed": total_bytes,
+            "infer_s": total_infer_s,
+            "achieved_flops_per_sec": total_flops / elapsed,
+            "mfu": total_flops / elapsed / peak if peak > 0 else 0.0,
+            "goodput": fractions["compute"] + fractions["env"],
+            "goodput_fractions": fractions,
+            "per_policy": per_policy,
+            "cost_models": {k: v for k, v in models.items() if k.startswith("serve/")},
         }
 
     def _write_summary(self, preempted: bool) -> None:
@@ -615,6 +692,22 @@ class PolicyServer:
         if not path:
             return
         _atomic_write_json(Path(path), self.summary(preempted=preempted))
+
+    def _write_perf_report(self) -> None:
+        """``perf_report.json``: env override, else next to the exit summary."""
+        if not obs_perf.perf_enabled(self.cfg):
+            return
+        path = os.environ.get(obs_perf.PERF_REPORT_ENV_VAR)
+        if not path:
+            summary_path = os.environ.get(SERVE_SUMMARY_ENV_VAR) or self.serve_cfg.summary_path
+            if summary_path:
+                path = str(Path(summary_path).parent / "perf_report.json")
+        if not path:
+            return
+        try:
+            _atomic_write_json(Path(path), self.perf_summary())
+        except OSError:
+            pass
 
 
 def _normalize_precision(spec: Any) -> str:

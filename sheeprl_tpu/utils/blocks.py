@@ -246,9 +246,15 @@ class FusedRingDispatcher:
         max_chunk: int = 8,
         last_sensitive: bool = False,
         futures: "WindowedFutures" = None,
+        cfg=None,
+        perf_name: str = None,
     ):
         self._builder = block_builder
         self._blocks: dict = {}
+        # Perf cost-model registration (obs/perf.py): each distinct chunk size is
+        # its own compiled program, so each registers its own FLOPs model.
+        self._cfg = cfg
+        self._perf_name = perf_name
         self._base_key = base_key
         self._max_programs = max_programs
         self._max_chunk = max_chunk
@@ -271,6 +277,10 @@ class FusedRingDispatcher:
         block = self._blocks.get(cache_key)
         if block is None:
             block = jax.jit(self._builder(k, cache_key[1]), donate_argnums=(0,))
+            if self._perf_name:
+                from sheeprl_tpu.obs import perf as obs_perf
+
+                block = obs_perf.instrument(self._cfg, f"{self._perf_name}_k{k}", block)
             self._blocks[cache_key] = block
         return block
 

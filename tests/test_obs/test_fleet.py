@@ -620,3 +620,112 @@ def test_exporter_answers_dump_fast_and_closes_fast_on_long_interval(tmp_path):
         )
     finally:
         agg.close()
+
+
+# ------------------------------------------------- PR-19: rotation + goodput
+def test_timeline_rotation_bounds_disk_and_loses_no_recent_rows(tmp_path):
+    """Size-capped timeline (``obs.fleet.max_timeline_mb``): crossing the cap
+    renames the live file to ``timeline.jsonl.1`` and starts fresh — disk stays
+    bounded at ~2x the cap, and the union of both generations still carries the
+    most recent rows for every slot."""
+    cap_bytes = 2048
+    agg = FleetAggregator(str(tmp_path / "fleet"), max_timeline_mb=cap_bytes / (1024 * 1024))
+    try:
+        assert agg.max_timeline_bytes == cap_bytes
+        exp = _exporter(agg, "learner")
+        flushes = 0
+        # rows are a few hundred bytes: enough flushes to cross the cap twice
+        for i in range(24):
+            exp.gauge("Perf/mfu", 0.1 + i * 0.01)
+            assert exp.flush()
+            flushes += 1
+            _wait_for(lambda: agg.rows_written >= flushes, msg=f"row {flushes}")
+        exp.close()
+
+        rotated = pathlib.Path(agg.rotated_timeline_path)
+        live = pathlib.Path(agg.timeline_path)
+        assert rotated.exists(), "cap crossed but no rotated generation"
+        assert live.stat().st_size < cap_bytes
+        assert rotated.stat().st_size < cap_bytes + 1024, "rotated file way past cap"
+
+        live_rows = [json.loads(line) for line in live.read_text().splitlines() if line]
+        rot_rows = [json.loads(line) for line in rotated.read_text().splitlines() if line]
+        seqs = sorted(r["seq"] for r in rot_rows + live_rows)
+        # rotation drops the OLDEST generation only: the newest rows survive
+        assert seqs[-1] == max(seqs) and len(seqs) == len(set(seqs))
+        assert live_rows, "live file empty after rotation"
+        assert live_rows[-1]["seq"] == max(seqs)
+    finally:
+        agg.close()
+
+
+def test_top_tail_rebuild_reads_across_rotation_boundary(tmp_path):
+    """Regression (PR-19 satellite): with snapshot.json missing, ``obs.top``
+    must rebuild from BOTH timeline generations — a slot whose last row landed
+    before the rotation still shows up, and a slot written in both generations
+    resolves to its newest (live-file) row."""
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+
+    def _row(role, actor_id, seq, **metrics):
+        row = {k: None for k in ROW_TAG_KEYS}
+        row.update(role=role, actor_id=actor_id, generation=0, pid=7, wall_clock=time.time(), seq=seq)
+        row["metrics"] = metrics
+        return json.dumps(row) + "\n"
+
+    # rotated generation: an actor slot that never wrote again + a stale learner row
+    (fleet_dir / "timeline.jsonl.1").write_text(
+        _row("actor", 1, 1, env_steps_per_s=9.0) + _row("learner", 0, 2, grad_steps_per_s=1.0)
+    )
+    # live generation: the learner's newer row must win over its rotated one
+    (fleet_dir / "timeline.jsonl").write_text(
+        _row("learner", 0, 3, grad_steps_per_s=5.5, **{"Perf/mfu": 0.42, "Perf/goodput": 0.87})
+    )
+
+    snap = fleet_top.load_snapshot(str(fleet_dir))
+    assert snap is not None and snap.get("rebuilt_from_timeline")
+    assert set(snap["processes"]) == {"actor1", "learner0"}
+    assert snap["processes"]["learner0"]["metrics"]["grad_steps_per_s"] == 5.5
+
+    table = fleet_top.format_top(snap)
+    assert "actor1" in table and "learner0" in table
+    # MFU / GOODPUT columns render the Perf/* gauges (MFU as a percentage)
+    assert "MFU%" in table and "GOODPUT" in table
+    assert "42.0" in table and "0.87" in table
+
+
+def test_goodput_rollup_written_at_close(tmp_path):
+    """``FleetAggregator.close()`` writes goodput.json: per-slot Perf gauges +
+    restart downtime from inter-generation timeline gaps, and a fleet section
+    naming the lowest-goodput slot as the ceiling."""
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    learner = _exporter(agg, "learner")
+    learner.gauge("Perf/goodput", 0.9)
+    learner.gauge("Perf/mfu", 0.33)
+    learner.gauge("perf_anomalies", 1.0)
+    assert learner.flush()
+    gen0 = _exporter(agg, "actor", actor_id=1, generation=0)
+    assert gen0.flush()
+    _wait_for(lambda: agg.rows_written >= 2, msg="gen0 rows")
+    gen0.close()
+    time.sleep(0.2)  # restart gap -> downtime in the rollup
+    gen1 = _exporter(agg, "actor", actor_id=1, generation=1)
+    gen1.gauge("Perf/goodput", 0.5)
+    assert gen1.flush()
+    _wait_for(lambda: agg.rows_written >= 3, msg="gen1 row")
+    learner.close()
+    gen1.close()
+    agg.close()
+
+    report = json.load(open(pathlib.Path(agg.goodput_path)))
+    slots = report["slots"]
+    assert {"learner0", "actor1"} <= set(slots)
+    assert slots["learner0"]["goodput"] == 0.9
+    assert slots["learner0"]["mfu"] == 0.33
+    assert slots["learner0"]["anomalies"] == 1.0
+    assert slots["actor1"]["generations"] == 2
+    assert slots["actor1"]["restart_downtime_s"] >= 0.15
+    fleet = report["fleet"]
+    assert fleet["min_goodput"] == 0.5
+    assert fleet["ceiling_slot"] == "actor1", "straggler attribution wrong"
+    assert fleet["anomalies"] == 1.0
